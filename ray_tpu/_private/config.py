@@ -1,0 +1,102 @@
+"""Env-overridable configuration registry.
+
+Equivalent of the reference's ``RAY_CONFIG`` macro table
+(``src/ray/common/ray_config_def.h``): every knob has a typed default and can be
+overridden per-process with ``RAY_TPU_<NAME>`` environment variables, so the
+whole cluster (GCS, raylets, workers) shares one config surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _coerce(value: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    if isinstance(default, (dict, list)):
+        return json.loads(value)
+    return value
+
+
+class _ConfigRegistry:
+    """Typed config table; attribute access returns the (env-overridden) value."""
+
+    _defs: Dict[str, Any] = {}
+
+    def define(self, name: str, default: Any, doc: str = "") -> None:
+        self._defs[name] = default
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            default = self._defs[name]
+        except KeyError:
+            raise AttributeError(f"unknown config {name!r}")
+        env = os.environ.get(_ENV_PREFIX + name.upper())
+        if env is not None:
+            return _coerce(env, default)
+        return default
+
+    def items(self):
+        return {k: getattr(self, k) for k in self._defs}.items()
+
+
+RAY_CONFIG = _ConfigRegistry()
+_d = RAY_CONFIG.define
+
+# --- networking / rpc ---
+_d("rpc_connect_timeout_s", 10.0)
+_d("rpc_call_timeout_s", 60.0)
+_d("rpc_retry_base_delay_ms", 50)
+_d("rpc_retry_max_delay_ms", 2000)
+_d("rpc_max_retries", 5)
+# Chaos injection (reference: src/ray/rpc/rpc_chaos.h). Format:
+#   "Method=N" -> fail the first N calls of Method;
+#   "Method=N:p" -> after the first N, fail with probability p.
+_d("testing_rpc_failure", "")
+_d("testing_rpc_delay_ms", 0)
+
+# --- GCS / control plane ---
+_d("gcs_port", 0)  # 0 -> pick a free port
+_d("health_check_period_ms", 1000)
+_d("health_check_timeout_ms", 5000)
+_d("gcs_storage", "memory")  # "memory" | "file"
+_d("pubsub_max_buffered", 4096)
+
+# --- raylet / scheduling ---
+_d("worker_pool_prestart", 0)
+_d("worker_idle_timeout_s", 300.0)
+_d("max_workers_per_node", 64)
+_d("lease_spillback_max_hops", 4)
+_d("scheduler_spread_threshold", 0.5)  # hybrid policy: pack below, spread above
+_d("worker_start_timeout_s", 60.0)
+
+# --- object store ---
+_d("object_store_memory", 2 * 1024**3)
+_d("object_inline_max_bytes", 100 * 1024)
+_d("object_chunk_bytes", 8 * 1024**2)
+_d("object_spill_dir", "")  # default: <session>/spill
+_d("object_pull_timeout_s", 120.0)
+_d("object_store_backend", "auto")  # "auto" | "cpp" | "shm"
+
+# --- tasks / actors ---
+_d("task_max_retries", 3)
+_d("actor_max_restarts", 0)
+_d("max_pending_lease_requests", 16)
+_d("max_lineage_bytes", 64 * 1024**2)
+
+# --- train / libs ---
+_d("train_health_check_period_s", 1.0)
+_d("serve_proxy_port", 8000)
+
+# --- logging / session ---
+_d("session_root", "/tmp/ray_tpu")
+_d("log_to_driver", True)

@@ -1,0 +1,78 @@
+"""Object serialization: cloudpickle + out-of-band zero-copy buffers.
+
+Equivalent of the reference's ``python/ray/_private/serialization.py``:
+values are pickled with protocol 5 and large contiguous buffers (numpy / jax
+arrays) are captured out-of-band so they can live in shared memory and be
+mapped zero-copy by readers.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+# Payloads >= this many bytes are pulled out-of-band; below it, inline pickling
+# is cheaper than a separate buffer round trip.
+_OOB_THRESHOLD = 1024
+
+
+def _to_picklable(value: Any) -> Any:
+    """Convert device arrays (jax) to host numpy without importing jax eagerly."""
+    t = type(value)
+    mod = t.__module__
+    if mod.startswith("jaxlib") or mod.startswith("jax"):
+        import numpy as np
+
+        try:
+            return np.asarray(value)
+        except Exception:
+            return value
+    return value
+
+
+def serialize(value: Any) -> Tuple[bytes, List[memoryview]]:
+    """Returns (inband_bytes, out_of_band_buffers)."""
+    buffers: List[memoryview] = []
+
+    def buffer_cb(pickle_buffer):
+        mv = pickle_buffer.raw()
+        if mv.nbytes >= _OOB_THRESHOLD:
+            buffers.append(mv)
+            return False  # out of band
+        return True  # keep in band
+
+    value = _to_picklable(value)
+    inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_cb)
+    return inband, buffers
+
+
+def deserialize(inband: bytes, buffers: List[Any]) -> Any:
+    return pickle.loads(inband, buffers=[pickle.PickleBuffer(b) for b in buffers])
+
+
+def dumps_oob(value: Any) -> bytes:
+    """Single-blob serialization: [u32 nbuf][u64 len, bytes]* [inband]."""
+    inband, buffers = serialize(value)
+    out = io.BytesIO()
+    out.write(len(buffers).to_bytes(4, "big"))
+    for b in buffers:
+        out.write(b.nbytes.to_bytes(8, "big"))
+        out.write(b)
+    out.write(inband)
+    return out.getvalue()
+
+
+def loads_oob(blob: bytes) -> Any:
+    view = memoryview(blob)
+    nbuf = int.from_bytes(view[:4], "big")
+    off = 4
+    buffers = []
+    for _ in range(nbuf):
+        n = int.from_bytes(view[off : off + 8], "big")
+        off += 8
+        buffers.append(view[off : off + n])
+        off += n
+    return deserialize(bytes(view[off:]), buffers)

@@ -1,0 +1,370 @@
+"""Global worker state and the public API implementation.
+
+Reference: python/ray/_private/worker.py (``ray.init`` at :1432, ``ray.get``
+:2863, ``ray.put`` :3010, ``ray.wait`` :3079, ``ray.remote`` :3564). Two
+execution modes:
+
+- local mode: tasks/actors execute inline in the driver process (reference's
+  ``local_mode``) — used for debugging and fast unit tests.
+- cluster mode: a ``CoreWorker`` connected to a GCS + raylet(s)
+  (``ray_tpu/_private/core_worker.py``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import inspect
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private.common import ActorOptions, TaskOptions
+from ray_tpu._private.config import RAY_CONFIG
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskError,
+)
+from ray_tpu.object_ref import ObjectRef
+
+_global_worker = None
+_lock = threading.RLock()
+
+
+def global_worker():
+    if _global_worker is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _global_worker
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None
+
+
+# ---------------------------------------------------------------------------
+# Local mode
+# ---------------------------------------------------------------------------
+
+
+class LocalWorker:
+    """Inline execution for debugging/tests (reference: local_mode)."""
+
+    mode = "local"
+
+    def __init__(self, namespace: str = "default"):
+        self.job_id = JobID.from_int(1)
+        self.namespace = namespace
+        self._objects: Dict[ObjectID, Any] = {}
+        self._actors: Dict[ActorID, Any] = {}
+        self._actor_meta: Dict[ActorID, Tuple[str, str]] = {}  # id -> (name, ns)
+        self._named: Dict[Tuple[str, str], ActorID] = {}
+        self._put_index = 0
+        self._task_id = TaskID.of(self.job_id)
+        self.current_task_id = self._task_id
+        self.current_actor_id: Optional[ActorID] = None
+
+    # -- objects --
+    def put(self, value: Any) -> ObjectRef:
+        self._put_index += 1
+        oid = ObjectID.from_put(self._task_id, self._put_index % 0x7FFF)
+        self._objects[oid] = value
+        return ObjectRef(oid)
+
+    def _store_result(self, oid: ObjectID, value: Any):
+        self._objects[oid] = value
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = []
+        for ref in refs:
+            if ref.id not in self._objects:
+                raise GetTimeoutError(f"object {ref.hex()} not found in local mode")
+            value = self._objects[ref.id]
+            if isinstance(value, TaskError):
+                raise value
+            out.append(value)
+        return out[0] if single else out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ready = [r for r in refs if r.id in self._objects]
+        return ready[:num_returns], [r for r in refs if r not in ready[:num_returns]]
+
+    def as_future(self, ref) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        try:
+            fut.set_result(self.get(ref))
+        except Exception as e:
+            fut.set_exception(e)
+        return fut
+
+    async def await_ref(self, ref):
+        return self.get(ref)
+
+    # -- tasks --
+    def _resolve_args(self, args, kwargs):
+        args = [self.get(a) if isinstance(a, ObjectRef) else a for a in args]
+        kwargs = {
+            k: self.get(v) if isinstance(v, ObjectRef) else v for k, v in kwargs.items()
+        }
+        return args, kwargs
+
+    def _execute(self, fn, args, kwargs, num_returns: int, refs: List[ObjectRef]):
+        try:
+            args, kwargs = self._resolve_args(args, kwargs)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = asyncio.get_event_loop().run_until_complete(result)
+            if num_returns == 1:
+                self._store_result(refs[0].id, result)
+            else:
+                values = list(result)
+                for ref, v in zip(refs, values):
+                    self._store_result(ref.id, v)
+        except Exception as e:
+            err = TaskError(repr(e), traceback.format_exc(), cause=e)
+            for ref in refs:
+                self._store_result(ref.id, err)
+
+    def submit_task(self, remote_fn, args, kwargs, opts: TaskOptions):
+        task_id = TaskID.of(self.job_id)
+        refs = [
+            ObjectRef(ObjectID.for_task_return(task_id, i))
+            for i in range(opts.num_returns)
+        ]
+        self._execute(remote_fn.function, args, kwargs, opts.num_returns, refs)
+        return refs[0] if opts.num_returns == 1 else refs
+
+    # -- actors --
+    def create_actor(self, actor_cls, args, kwargs, opts: ActorOptions):
+        from ray_tpu.actor import ActorHandle
+
+        if opts.name and opts.get_if_exists:
+            key = (opts.namespace or self.namespace, opts.name)
+            if key in self._named:
+                aid = self._named[key]
+                inst = self._actors[aid]
+                return ActorHandle(aid, _instance_methods(inst), type(inst).__name__)
+        actor_id = ActorID.of(self.job_id)
+        args, kwargs = self._resolve_args(args, kwargs)
+        instance = actor_cls.cls(*args, **kwargs)
+        self._actors[actor_id] = instance
+        if opts.name:
+            key = (opts.namespace or self.namespace, opts.name)
+            if key in self._named:
+                raise ValueError(f"actor name {opts.name!r} already taken")
+            self._named[key] = actor_id
+            self._actor_meta[actor_id] = key
+        return ActorHandle(actor_id, _instance_methods(instance), actor_cls.class_name)
+
+    def submit_actor_task(self, handle, method_name, args, kwargs, num_returns=1):
+        if handle.actor_id not in self._actors:
+            raise ActorDiedError(f"actor {handle.actor_id.hex()} is dead")
+        instance = self._actors[handle.actor_id]
+        task_id = TaskID.of(self.job_id)
+        refs = [
+            ObjectRef(ObjectID.for_task_return(task_id, i)) for i in range(num_returns)
+        ]
+        method = getattr(instance, method_name)
+        prev = self.current_actor_id
+        self.current_actor_id = handle.actor_id
+        try:
+            self._execute(method, args, kwargs, num_returns, refs)
+        finally:
+            self.current_actor_id = prev
+        return refs[0] if num_returns == 1 else refs
+
+    def get_actor(self, name: str, namespace: Optional[str] = None):
+        from ray_tpu.actor import ActorHandle
+
+        key = (namespace or self.namespace, name)
+        if key not in self._named:
+            raise ValueError(f"no actor named {name!r}")
+        aid = self._named[key]
+        inst = self._actors[aid]
+        return ActorHandle(aid, _instance_methods(inst), type(inst).__name__)
+
+    def kill_actor(self, handle, no_restart=True):
+        self._actors.pop(handle.actor_id, None)
+        key = self._actor_meta.pop(handle.actor_id, None)
+        if key:
+            self._named.pop(key, None)
+
+    def cancel(self, ref, force=False, recursive=True):
+        pass  # inline tasks already completed
+
+    # -- cluster info --
+    def cluster_resources(self):
+        import os
+
+        return {"CPU": float(os.cpu_count() or 1)}
+
+    def available_resources(self):
+        return self.cluster_resources()
+
+    def nodes(self):
+        return []
+
+    def shutdown(self):
+        self._objects.clear()
+        self._actors.clear()
+        self._named.clear()
+
+    def free_objects(self, ids):
+        for i in ids:
+            self._objects.pop(i, None)
+
+
+def _instance_methods(instance):
+    return [
+        n
+        for n in dir(instance)
+        if not n.startswith("__") and callable(getattr(instance, n, None))
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    local_mode: bool = False,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+    object_store_memory: Optional[int] = None,
+    log_to_driver: bool = True,
+    _system_config: Optional[Dict[str, Any]] = None,
+):
+    """Start (or connect to) a cluster and attach this process as the driver.
+
+    Reference: ray.init (python/ray/_private/worker.py:1432).
+    """
+    global _global_worker
+    with _lock:
+        if _global_worker is not None:
+            if ignore_reinit_error:
+                return _global_worker
+            raise RuntimeError("ray_tpu.init() already called (use ignore_reinit_error=True)")
+        if _system_config:
+            import os
+
+            for k, v in _system_config.items():
+                os.environ[f"RAY_TPU_{k.upper()}"] = str(v)
+        if local_mode:
+            _global_worker = LocalWorker(namespace=namespace)
+            return _global_worker
+        from ray_tpu._private.core_worker import connect_driver
+
+        _global_worker = connect_driver(
+            address=address,
+            num_cpus=num_cpus,
+            num_tpus=num_tpus,
+            resources=resources or {},
+            labels=labels or {},
+            namespace=namespace,
+            object_store_memory=object_store_memory,
+            log_to_driver=log_to_driver,
+        )
+        return _global_worker
+
+
+def shutdown():
+    global _global_worker
+    with _lock:
+        if _global_worker is not None:
+            _global_worker.shutdown()
+            _global_worker = None
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() on an ObjectRef is not allowed")
+    return global_worker().put(value)
+
+
+def get(refs, *, timeout: Optional[float] = None):
+    if isinstance(refs, (list, tuple)):
+        bad = [r for r in refs if not isinstance(r, ObjectRef)]
+        if bad:
+            raise TypeError(f"get() expects ObjectRefs, got {type(bad[0]).__name__}")
+    elif not isinstance(refs, ObjectRef):
+        raise TypeError(f"get() expects an ObjectRef, got {type(refs).__name__}")
+    return global_worker().get(refs, timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds number of refs")
+    return global_worker().wait(
+        list(refs), num_returns=num_returns, timeout=timeout, fetch_local=fetch_local
+    )
+
+
+def kill(actor_handle, *, no_restart: bool = True):
+    global_worker().kill_actor(actor_handle, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    global_worker().cancel(ref, force=force, recursive=recursive)
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    return global_worker().get_actor(name, namespace)
+
+
+def remote(*args, **kwargs):
+    """@ray_tpu.remote decorator for functions and classes.
+
+    Reference: ray.remote (python/ray/_private/worker.py:3564).
+    """
+    from ray_tpu.actor import ActorClass, build_actor_options
+    from ray_tpu.remote_function import RemoteFunction, build_task_options
+
+    def make(target, options):
+        if inspect.isclass(target):
+            return ActorClass(target, build_actor_options(ActorOptions(), options))
+        if not callable(target):
+            raise TypeError("@remote must decorate a function or class")
+        opts = build_task_options(TaskOptions(), options)
+        return RemoteFunction(target, opts)
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or inspect.isclass(args[0])):
+        return make(args[0], {})
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+
+    def decorator(target):
+        return make(target, kwargs)
+
+    return decorator
+
+
+def cluster_resources() -> Dict[str, float]:
+    return global_worker().cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return global_worker().available_resources()
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return global_worker().nodes()

@@ -1,0 +1,62 @@
+"""ObjectRef: a future for a value in the distributed object store.
+
+Reference: ObjectRef in python/ray/includes/object_ref.pxi — an id plus owner
+metadata; values are resolved with ``ray_tpu.get``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_address", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: str = ""):
+        self._id = object_id
+        self._owner_address = owner_address
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def owner_address(self) -> str:
+        return self._owner_address
+
+    def task_id(self):
+        return self._id.task_id()
+
+    def future(self):
+        """concurrent.futures.Future resolving to the value."""
+        from ray_tpu._private import worker as _worker
+
+        return _worker.global_worker().as_future(self)
+
+    def __reduce__(self):
+        return (_rebuild_ref, (self._id.binary(), self._owner_address))
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __await__(self):
+        from ray_tpu._private import worker as _worker
+
+        return _worker.global_worker().await_ref(self).__await__()
+
+
+def _rebuild_ref(id_bytes: bytes, owner_address: str) -> ObjectRef:
+    return ObjectRef(ObjectID(id_bytes), owner_address)

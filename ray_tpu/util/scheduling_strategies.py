@@ -1,0 +1,37 @@
+"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: object
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str  # hex node id
+    soft: bool = False
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Schedule onto a node whose labels match (reference:
+    src/ray/raylet/scheduling/policy/node_label_scheduling_policy.cc)."""
+
+    hard: Dict[str, str] = field(default_factory=dict)
+    soft: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SpreadSchedulingStrategy:
+    pass
+
+
+DEFAULT = "DEFAULT"
+SPREAD = "SPREAD"

@@ -1,0 +1,23 @@
+"""Test config: force a virtual 8-device CPU mesh before jax initializes.
+
+Mirrors the reference's CPU test tier (SURVEY.md §4): all sharding/collective
+tests run on xla_force_host_platform_device_count=8 so CI needs no TPUs.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_local():
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(local_mode=True)
+    yield ray_tpu
+    ray_tpu.shutdown()

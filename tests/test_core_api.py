@@ -1,0 +1,123 @@
+"""Core API tests in local mode (reference: python/ray/tests/test_basic.py tier)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+
+
+def test_put_get(ray_local):
+    ref = ray_tpu.put({"a": 1, "b": np.arange(10)})
+    out = ray_tpu.get(ref)
+    assert out["a"] == 1
+    np.testing.assert_array_equal(out["b"], np.arange(10))
+
+
+def test_task_roundtrip(ray_local):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+    # ObjectRef args resolve before execution
+    assert ray_tpu.get(add.remote(add.remote(1, 1), 3)) == 5
+
+
+def test_multiple_returns(ray_local):
+    @ray_tpu.remote(num_returns=2)
+    def two():
+        return 1, 2
+
+    a, b = two.remote()
+    assert ray_tpu.get(a) == 1 and ray_tpu.get(b) == 2
+
+
+def test_task_error_propagates(ray_local):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_options_override(ray_local):
+    @ray_tpu.remote
+    def f():
+        return 42
+
+    assert ray_tpu.get(f.options(num_cpus=2, num_returns=1).remote()) == 42
+    with pytest.raises(ValueError):
+        f.options(bogus=1)
+
+
+def test_actor_basics(ray_local):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, by=1):
+            self.n += by
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote()) == 11
+    assert ray_tpu.get(c.incr.remote(5)) == 16
+    with pytest.raises(AttributeError):
+        c.nonexistent
+
+
+def test_named_actor(ray_local):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    A.options(name="the_actor").remote()
+    h = ray_tpu.get_actor("the_actor")
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+    ray_tpu.kill(h)
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("the_actor")
+
+
+def test_wait(ray_local):
+    refs = [ray_tpu.put(i) for i in range(4)]
+    ready, rest = ray_tpu.wait(refs, num_returns=2)
+    assert len(ready) == 2 and len(rest) == 2
+
+
+def test_runtime_context(ray_local):
+    ctx = ray_tpu.get_runtime_context()
+    assert len(ctx.get_job_id()) == 8
+
+
+def test_cannot_call_remote_directly(ray_local):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_serialization_oob_roundtrip():
+    from ray_tpu._private.serialization import dumps_oob, loads_oob
+
+    arr = np.random.rand(1000, 100)
+    blob = dumps_oob({"x": arr, "y": [1, 2, 3]})
+    out = loads_oob(blob)
+    np.testing.assert_array_equal(out["x"], arr)
+    assert out["y"] == [1, 2, 3]
+
+
+def test_ids_structure():
+    from ray_tpu._private.ids import JobID, ObjectID, TaskID
+
+    job = JobID.from_int(7)
+    task = TaskID.of(job)
+    assert task.job_id() == job
+    obj = ObjectID.for_task_return(task, 3)
+    assert obj.task_id() == task and obj.return_index() == 3
