@@ -1,0 +1,790 @@
+"""GCS: the cluster control plane.
+
+Reference: ``src/ray/gcs/gcs_server.cc`` (subsystem init at :266-294) — node
+membership + health (``gcs_node_manager.cc``, ``gcs_health_check_manager.cc``),
+resource view (``gcs_resource_manager.cc``), actor directory + fault tolerance
+(``gcs_actor_manager.h``, ``gcs_actor_scheduler.cc``), placement groups with
+2PC reserve/commit (``gcs_placement_group_manager.h``,
+``gcs_placement_group_scheduler.h:115-118``), job table (``gcs_job_manager.cc``),
+internal KV (``gcs_kv_manager.cc``), pubsub (``src/ray/pubsub``), and a
+GCS-hosted object directory (deviation: the reference resolves object
+locations via owners — ``ownership_object_directory.cc``; round 1 centralizes
+the directory here and owners serve small objects directly).
+
+TPU-first: node resources carry ``TPU`` chips and slice/topology labels, and
+actor/PG scheduling can select on them (slice-affine gang scheduling).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.common import (
+    Bundle,
+    NodeInfo,
+    PlacementGroupSpec,
+    TaskSpec,
+    label_match,
+    resources_ge,
+)
+from ray_tpu._private.config import RAY_CONFIG
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.rpc import RpcError, RpcServer, RetryingRpcClient, ServerConnection
+
+logger = logging.getLogger("ray_tpu.gcs")
+
+
+class ActorRecord:
+    def __init__(self, actor_id: ActorID, spec: TaskSpec):
+        self.actor_id = actor_id
+        self.spec = spec
+        opts = spec.actor_options
+        self.name = opts.name or ""
+        self.namespace = opts.namespace or "default"
+        self.lifetime = opts.lifetime
+        self.max_restarts = opts.max_restarts
+        self.restarts_used = 0
+        self.state = "PENDING_CREATION"
+        self.address = ""
+        self.node_id: Optional[NodeID] = None
+        self.job_id = spec.job_id
+        self.death_cause = ""
+        self.class_name = ""
+        self.pending_kill = False
+
+    def info(self) -> dict:
+        return {
+            "actor_id": self.actor_id.hex(),
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id.hex() if self.node_id else "",
+            "name": self.name,
+            "namespace": self.namespace,
+            "restarts_used": self.restarts_used,
+            "max_restarts": self.max_restarts,
+            "death_cause": self.death_cause,
+            "class_name": self.class_name,
+            "job_id": self.job_id.hex(),
+            "lifetime": self.lifetime,
+        }
+
+
+class PGRecord:
+    def __init__(self, spec: PlacementGroupSpec):
+        self.spec = spec
+        self.state = "PENDING"  # PENDING | CREATED | REMOVED | RESCHEDULING
+        self.bundle_nodes: List[Optional[NodeID]] = [None] * len(spec.bundles)
+        self.ready_event = asyncio.Event()
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = RpcServer(self._handle, host, port)
+        self.server.on_disconnect = self._on_disconnect
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.node_available: Dict[NodeID, Dict[str, float]] = {}
+        self.node_last_seen: Dict[NodeID, float] = {}
+        self.node_clients: Dict[NodeID, RetryingRpcClient] = {}
+        self.kv: Dict[Tuple[str, str], bytes] = {}
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.pgs: Dict[PlacementGroupID, PGRecord] = {}
+        self.jobs: Dict[JobID, dict] = {}
+        self.job_counter = 0
+        self.object_dir: Dict[bytes, Set[NodeID]] = {}
+        self.subs: Dict[int, Tuple[ServerConnection, Set[str]]] = {}
+        self.conn_jobs: Dict[int, JobID] = {}
+        self._worker_clients: Dict[str, RetryingRpcClient] = {}
+        self._background: List[asyncio.Task] = []
+        self.start_time = time.time()
+
+    async def start(self) -> str:
+        addr = await self.server.start()
+        self._background.append(asyncio.ensure_future(self._health_check_loop()))
+        logger.info("GCS listening on %s", addr)
+        return addr
+
+    async def stop(self):
+        for t in self._background:
+            t.cancel()
+        await self.server.stop()
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    async def _handle(self, method: str, payload: bytes, conn) -> bytes:
+        fn = getattr(self, f"_rpc_{method}", None)
+        if fn is None:
+            raise RpcError(f"GCS: unknown method {method}")
+        req = pickle.loads(payload) if payload else {}
+        resp = await fn(req, conn)
+        return pickle.dumps(resp)
+
+    def _publish(self, channel: str, message: dict):
+        payload = pickle.dumps(message)
+        for conn, channels in list(self.subs.values()):
+            if channel in channels:
+                asyncio.ensure_future(conn.push(channel, payload))
+
+    async def _on_disconnect(self, conn: ServerConnection):
+        self.subs.pop(conn.conn_id, None)
+        job_id = self.conn_jobs.pop(conn.conn_id, None)
+        if job_id is not None and job_id in self.jobs:
+            await self._finish_job(job_id)
+
+    # ------------------------------------------------------------------
+    # nodes / health
+    # ------------------------------------------------------------------
+
+    async def _rpc_RegisterNode(self, req, conn):
+        info: NodeInfo = req["info"]
+        self.nodes[info.node_id] = info
+        self.node_available[info.node_id] = dict(info.total_resources)
+        self.node_last_seen[info.node_id] = time.monotonic()
+        self.node_clients[info.node_id] = RetryingRpcClient(info.address)
+        logger.info("node %s registered: %s labels=%s", info.node_id.hex()[:8],
+                    info.total_resources, info.labels)
+        self._publish("nodes", {"event": "added", "node": info.to_dict()})
+        return {"status": "ok"}
+
+    async def _rpc_Heartbeat(self, req, conn):
+        node_id: NodeID = req["node_id"]
+        if node_id not in self.nodes:
+            return {"status": "unknown_node"}  # raylet should re-register
+        self.node_last_seen[node_id] = time.monotonic()
+        self.node_available[node_id] = req["available"]
+        return {"status": "ok"}
+
+    async def _rpc_GetAllNodes(self, req, conn):
+        return {"nodes": [n.to_dict() for n in self.nodes.values()]}
+
+    async def _rpc_GetClusterResources(self, req, conn):
+        total: Dict[str, float] = {}
+        avail: Dict[str, float] = {}
+        for nid, info in self.nodes.items():
+            if not info.alive:
+                continue
+            for k, v in info.total_resources.items():
+                total[k] = total.get(k, 0.0) + v
+            for k, v in self.node_available.get(nid, {}).items():
+                avail[k] = avail.get(k, 0.0) + v
+        return {"total": total, "available": avail}
+
+    async def _rpc_DrainNode(self, req, conn):
+        node_id: NodeID = req["node_id"]
+        await self._mark_node_dead(node_id, "drained")
+        return {"status": "ok"}
+
+    async def _health_check_loop(self):
+        period = RAY_CONFIG.health_check_period_ms / 1000.0
+        timeout = RAY_CONFIG.health_check_timeout_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node_id, info in list(self.nodes.items()):
+                if info.alive and now - self.node_last_seen.get(node_id, now) > timeout:
+                    await self._mark_node_dead(node_id, "health check timeout")
+
+    async def _mark_node_dead(self, node_id: NodeID, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        self.node_available.pop(node_id, None)
+        logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
+        self._publish("nodes", {"event": "removed", "node_id": node_id.hex(), "reason": reason})
+        # drop object locations on that node
+        for oid, nodes in list(self.object_dir.items()):
+            nodes.discard(node_id)
+            if not nodes:
+                del self.object_dir[oid]
+        # fail over actors that lived there
+        for record in list(self.actors.values()):
+            if record.node_id == node_id and record.state in ("ALIVE", "PENDING_CREATION"):
+                await self._on_actor_worker_lost(record, f"node died: {reason}")
+        # reschedule placement groups with bundles there
+        for pg in self.pgs.values():
+            if pg.state == "CREATED" and any(n == node_id for n in pg.bundle_nodes):
+                pg.state = "RESCHEDULING"
+                asyncio.ensure_future(self._schedule_pg(pg))
+
+    # ------------------------------------------------------------------
+    # kv
+    # ------------------------------------------------------------------
+
+    async def _rpc_KVPut(self, req, conn):
+        key = (req.get("ns", ""), req["key"])
+        if not req.get("overwrite", True) and key in self.kv:
+            return {"added": False}
+        self.kv[key] = req["value"]
+        return {"added": True}
+
+    async def _rpc_KVGet(self, req, conn):
+        return {"value": self.kv.get((req.get("ns", ""), req["key"]))}
+
+    async def _rpc_KVDel(self, req, conn):
+        prefix = req.get("prefix", False)
+        ns = req.get("ns", "")
+        if prefix:
+            keys = [k for k in self.kv if k[0] == ns and k[1].startswith(req["key"])]
+            for k in keys:
+                del self.kv[k]
+            return {"deleted": len(keys)}
+        return {"deleted": 1 if self.kv.pop((ns, req["key"]), None) is not None else 0}
+
+    async def _rpc_KVKeys(self, req, conn):
+        ns = req.get("ns", "")
+        prefix = req.get("prefix", "")
+        return {"keys": [k[1] for k in self.kv if k[0] == ns and k[1].startswith(prefix)]}
+
+    # ------------------------------------------------------------------
+    # jobs
+    # ------------------------------------------------------------------
+
+    async def _rpc_RegisterDriver(self, req, conn):
+        self.job_counter += 1
+        job_id = JobID.from_int(self.job_counter)
+        self.jobs[job_id] = {
+            "job_id": job_id.hex(),
+            "driver_address": req.get("address", ""),
+            "namespace": req.get("namespace", "default"),
+            "start_time": time.time(),
+            "state": "RUNNING",
+            "entrypoint": req.get("entrypoint", ""),
+        }
+        self.conn_jobs[conn.conn_id] = job_id
+        return {"job_id": job_id.binary()}
+
+    async def _rpc_ListJobs(self, req, conn):
+        return {"jobs": list(self.jobs.values())}
+
+    async def _finish_job(self, job_id: JobID):
+        job = self.jobs.get(job_id)
+        if job is None or job["state"] == "FINISHED":
+            return
+        job["state"] = "FINISHED"
+        job["end_time"] = time.time()
+        logger.info("job %s finished; reaping its actors", job_id.hex())
+        for record in list(self.actors.values()):
+            if record.job_id == job_id and record.lifetime != "detached" and record.state != "DEAD":
+                await self._kill_actor(record, no_restart=True, reason="owning job finished")
+        for pg in list(self.pgs.values()):
+            if pg.spec.creator_job == job_id and pg.spec.lifetime != "detached":
+                await self._remove_pg(pg)
+
+    # ------------------------------------------------------------------
+    # pubsub
+    # ------------------------------------------------------------------
+
+    async def _rpc_Subscribe(self, req, conn):
+        channels = set(req["channels"])
+        existing = self.subs.get(conn.conn_id)
+        if existing:
+            existing[1].update(channels)
+        else:
+            self.subs[conn.conn_id] = (conn, channels)
+        return {"status": "ok"}
+
+    async def _rpc_Publish(self, req, conn):
+        self._publish(req["channel"], req["message"])
+        return {"status": "ok"}
+
+    # ------------------------------------------------------------------
+    # object directory
+    # ------------------------------------------------------------------
+
+    async def _rpc_ObjectLocAdd(self, req, conn):
+        for oid in req["oids"]:
+            self.object_dir.setdefault(oid, set()).add(req["node_id"])
+        return {"status": "ok"}
+
+    async def _rpc_ObjectLocRemove(self, req, conn):
+        for oid in req["oids"]:
+            nodes = self.object_dir.get(oid)
+            if nodes:
+                nodes.discard(req["node_id"])
+                if not nodes:
+                    del self.object_dir[oid]
+        return {"status": "ok"}
+
+    async def _rpc_ObjectLocGet(self, req, conn):
+        out = []
+        for node_id in self.object_dir.get(req["oid"], ()):  # alive nodes only
+            info = self.nodes.get(node_id)
+            if info is not None and info.alive:
+                out.append({"node_id": node_id.hex(), "address": info.address})
+        return {"locations": out}
+
+    # ------------------------------------------------------------------
+    # scheduling helpers
+    # ------------------------------------------------------------------
+
+    def _feasible_nodes(self, resources: Dict[str, float], selector: Dict[str, str],
+                        check_available: bool = True) -> List[NodeID]:
+        out = []
+        for node_id, info in self.nodes.items():
+            if not info.alive:
+                continue
+            if selector and not label_match(info.labels, selector):
+                continue
+            pool = self.node_available.get(node_id, {}) if check_available else info.total_resources
+            if resources_ge(pool, resources):
+                out.append(node_id)
+        return out
+
+    def _pick_node(self, resources: Dict[str, float], selector: Dict[str, str]) -> Optional[NodeID]:
+        """Hybrid policy: pack onto the most-utilized feasible node below the
+        spread threshold, else least-utilized (reference:
+        raylet/scheduling/policy/hybrid_scheduling_policy.cc)."""
+        feasible = self._feasible_nodes(resources, selector)
+        if not feasible:
+            # fall back to nodes that are feasible by total resources (queue there)
+            feasible = self._feasible_nodes(resources, selector, check_available=False)
+            if not feasible:
+                return None
+        def utilization(nid):
+            info = self.nodes[nid]
+            avail = self.node_available.get(nid, {})
+            fracs = [
+                1.0 - avail.get(k, 0.0) / v
+                for k, v in info.total_resources.items()
+                if v > 0
+            ]
+            return max(fracs) if fracs else 0.0
+        scored = sorted(feasible, key=lambda nid: (utilization(nid), nid.hex()))
+        threshold = RAY_CONFIG.scheduler_spread_threshold
+        packed = [nid for nid in scored if utilization(nid) < threshold]
+        if packed:
+            return packed[-1]  # most utilized below threshold -> pack
+        return scored[0]  # least utilized -> spread
+
+    async def _rpc_PickNode(self, req, conn):
+        """Owner-side lease policy support: pick a node for a task's resource
+        shape + label selector (reference: owner lease_policy.cc + raylet
+        spillback; centralized here on the GCS resource view)."""
+        strat = req.get("strategy")
+        if strat == "SPREAD":
+            feasible = self._feasible_nodes(req["resources"], req.get("selector", {}))
+            if feasible:
+                idx = req.get("spread_hint", 0) % len(feasible)
+                nid = sorted(feasible, key=lambda n: n.hex())[idx]
+                return {"node": self._node_addr(nid)}
+        nid = self._pick_node(req["resources"], req.get("selector", {}))
+        return {"node": self._node_addr(nid) if nid else None}
+
+    def _node_addr(self, nid: NodeID) -> dict:
+        info = self.nodes[nid]
+        return {"node_id": nid.hex(), "address": info.address}
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+
+    def _worker_client(self, address: str) -> RetryingRpcClient:
+        client = self._worker_clients.get(address)
+        if client is None:
+            client = RetryingRpcClient(address)
+            self._worker_clients[address] = client
+        return client
+
+    async def _rpc_CreateActor(self, req, conn):
+        spec: TaskSpec = req["spec"]
+        opts = spec.actor_options
+        if opts.name:
+            key = (opts.namespace or "default", opts.name)
+            existing = self.named_actors.get(key)
+            if existing is not None and self.actors[existing].state != "DEAD":
+                if opts.get_if_exists:
+                    return {"status": "exists", "info": self.actors[existing].info()}
+                return {"status": "name_taken"}
+        actor_id = spec.actor_id
+        record = ActorRecord(actor_id, spec)
+        record.class_name = req.get("class_name", "")
+        self.actors[actor_id] = record
+        if record.name:
+            self.named_actors[(record.namespace, record.name)] = actor_id
+        asyncio.ensure_future(self._schedule_actor(record))
+        return {"status": "ok", "info": record.info()}
+
+    async def _schedule_actor(self, record: ActorRecord):
+        """Lease a worker on a feasible node and push the creation task.
+
+        Reference: gcs_actor_scheduler.cc (lease-based actor scheduling).
+        """
+        spec = record.spec
+        opts = spec.actor_options
+        resources = opts.required_resources()
+        deadline = time.monotonic() + 3600.0
+        warned = False
+        while record.state in ("PENDING_CREATION", "RESTARTING") and not record.pending_kill:
+            node_id = None
+            if opts.placement_group is not None:
+                node_id = self._pg_bundle_node(opts)
+            else:
+                strat = opts.scheduling_strategy
+                selector = dict(opts.label_selector)
+                if strat is not None and hasattr(strat, "hard"):
+                    selector.update(strat.hard)
+                if strat is not None and hasattr(strat, "node_id"):
+                    node_id = NodeID.from_hex(strat.node_id)
+                else:
+                    node_id = self._pick_node(resources, selector)
+            if node_id is None or node_id not in self.nodes or not self.nodes[node_id].alive:
+                if not warned and time.monotonic() > deadline - 3590:
+                    pass
+                if not warned:
+                    logger.warning(
+                        "actor %s infeasible (resources=%s); waiting for nodes",
+                        record.actor_id.hex()[:8], resources)
+                    warned = True
+                await asyncio.sleep(0.5)
+                if time.monotonic() > deadline:
+                    record.state = "DEAD"
+                    record.death_cause = "scheduling timed out"
+                    self._publish_actor(record)
+                    return
+                continue
+            try:
+                client = self.node_clients[node_id]
+                reply = pickle.loads(await client.call("RequestWorkerLease", pickle.dumps({
+                    "resources": resources,
+                    "label_selector": opts.label_selector,
+                    "job_id": spec.job_id,
+                    "pg": (opts.placement_group.id.binary()
+                           if opts.placement_group is not None else None),
+                    "bundle_index": opts.placement_group_bundle_index,
+                    "for_actor": record.actor_id.binary(),
+                }), timeout=RAY_CONFIG.worker_start_timeout_s + 30))
+                if reply.get("status") != "granted":
+                    await asyncio.sleep(0.2)
+                    continue
+                worker_addr = reply["worker_address"]
+                wreply = pickle.loads(await self._worker_client(worker_addr).call(
+                    "PushTask", pickle.dumps({"spec": spec}), timeout=600.0))
+                if wreply.get("status") != "ok":
+                    logger.warning("actor %s creation failed on %s: %s",
+                                   record.actor_id.hex()[:8], worker_addr,
+                                   wreply.get("error", "")[:500])
+                    record.state = "DEAD"
+                    record.death_cause = wreply.get("error", "creation task failed")
+                    self._publish_actor(record)
+                    return
+                record.state = "ALIVE"
+                record.address = worker_addr
+                record.node_id = node_id
+                self._publish_actor(record)
+                return
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.warning("actor %s scheduling attempt failed: %s",
+                               record.actor_id.hex()[:8], e)
+                await asyncio.sleep(0.3)
+
+    def _pg_bundle_node(self, opts) -> Optional[NodeID]:
+        pg_id = opts.placement_group.id
+        pg = self.pgs.get(pg_id)
+        if pg is None or pg.state != "CREATED":
+            return None
+        idx = opts.placement_group_bundle_index
+        if idx < 0:
+            idx = 0
+        return pg.bundle_nodes[idx]
+
+    def _publish_actor(self, record: ActorRecord):
+        self._publish("actors", {"event": "state", "info": record.info()})
+
+    async def _on_actor_worker_lost(self, record: ActorRecord, reason: str):
+        if record.state == "DEAD":
+            return
+        if record.pending_kill or (record.max_restarts != -1
+                                   and record.restarts_used >= record.max_restarts):
+            record.state = "DEAD"
+            record.death_cause = reason
+            self._publish_actor(record)
+            return
+        record.restarts_used += 1
+        record.state = "RESTARTING"
+        record.address = ""
+        record.node_id = None
+        self._publish_actor(record)
+        asyncio.ensure_future(self._schedule_actor(record))
+
+    async def _rpc_GetActorInfo(self, req, conn):
+        record = self.actors.get(ActorID(req["actor_id"]))
+        return {"info": record.info() if record else None}
+
+    async def _rpc_WaitActorReady(self, req, conn):
+        actor_id = ActorID(req["actor_id"])
+        deadline = time.monotonic() + req.get("timeout", 300.0)
+        while time.monotonic() < deadline:
+            record = self.actors.get(actor_id)
+            if record is None:
+                return {"info": None}
+            if record.state in ("ALIVE", "DEAD"):
+                return {"info": record.info()}
+            await asyncio.sleep(0.05)
+        return {"info": self.actors[actor_id].info() if actor_id in self.actors else None}
+
+    async def _rpc_GetNamedActor(self, req, conn):
+        key = (req.get("namespace", "default"), req["name"])
+        actor_id = self.named_actors.get(key)
+        if actor_id is None or self.actors[actor_id].state == "DEAD":
+            return {"info": None}
+        return {"info": self.actors[actor_id].info()}
+
+    async def _rpc_ListActors(self, req, conn):
+        return {"actors": [r.info() for r in self.actors.values()]}
+
+    async def _rpc_KillActor(self, req, conn):
+        record = self.actors.get(ActorID(req["actor_id"]))
+        if record is None:
+            return {"status": "not_found"}
+        await self._kill_actor(record, req.get("no_restart", True), "ray_tpu.kill")
+        return {"status": "ok"}
+
+    async def _kill_actor(self, record: ActorRecord, no_restart: bool, reason: str):
+        if no_restart:
+            record.pending_kill = True
+        address = record.address
+        if record.state == "ALIVE" and record.node_id in self.node_clients and address:
+            try:
+                await self.node_clients[record.node_id].call(
+                    "KillWorker", pickle.dumps({"worker_address": address}), timeout=10.0,
+                    retries=0)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
+        if no_restart:
+            record.state = "DEAD"
+            record.death_cause = reason
+            if (record.namespace, record.name) in self.named_actors:
+                if self.named_actors[(record.namespace, record.name)] == record.actor_id:
+                    del self.named_actors[(record.namespace, record.name)]
+            self._publish_actor(record)
+
+    async def _rpc_WorkerDied(self, req, conn):
+        """Raylet tells us a worker process exited (reference: raylet→GCS
+        worker failure report; owners learn via the `workers` channel)."""
+        address = req["worker_address"]
+        self._publish("workers", {"event": "died", "worker_address": address,
+                                  "node_id": req.get("node_id")})
+        for record in self.actors.values():
+            if record.address == address and record.state == "ALIVE":
+                await self._on_actor_worker_lost(record, req.get("reason", "worker died"))
+        return {"status": "ok"}
+
+    # ------------------------------------------------------------------
+    # placement groups (2PC reserve/commit)
+    # ------------------------------------------------------------------
+
+    async def _rpc_CreatePlacementGroup(self, req, conn):
+        spec: PlacementGroupSpec = req["spec"]
+        pg = PGRecord(spec)
+        self.pgs[spec.pg_id] = pg
+        asyncio.ensure_future(self._schedule_pg(pg))
+        return {"status": "ok"}
+
+    async def _rpc_WaitPlacementGroupReady(self, req, conn):
+        pg = self.pgs.get(PlacementGroupID(req["pg_id"]))
+        if pg is None:
+            return {"status": "not_found"}
+        try:
+            await asyncio.wait_for(pg.ready_event.wait(), req.get("timeout", 300.0))
+            return {"status": "ready" if pg.state == "CREATED" else pg.state,
+                    "bundle_nodes": [n.hex() if n else "" for n in pg.bundle_nodes]}
+        except asyncio.TimeoutError:
+            return {"status": "timeout"}
+
+    async def _rpc_GetPlacementGroup(self, req, conn):
+        pg = self.pgs.get(PlacementGroupID(req["pg_id"]))
+        if pg is None:
+            return {"info": None}
+        return {"info": {
+            "pg_id": pg.spec.pg_id.hex(),
+            "state": pg.state,
+            "strategy": pg.spec.strategy,
+            "name": pg.spec.name,
+            "bundles": [dict(b.resources) for b in pg.spec.bundles],
+            "bundle_nodes": [n.hex() if n else "" for n in pg.bundle_nodes],
+        }}
+
+    async def _rpc_RemovePlacementGroup(self, req, conn):
+        pg = self.pgs.get(PlacementGroupID(req["pg_id"]))
+        if pg is not None:
+            await self._remove_pg(pg)
+        return {"status": "ok"}
+
+    async def _remove_pg(self, pg: PGRecord):
+        pg.state = "REMOVED"
+        for idx, node_id in enumerate(pg.bundle_nodes):
+            if node_id is not None and node_id in self.node_clients:
+                try:
+                    await self.node_clients[node_id].call("ReleasePGBundles", pickle.dumps(
+                        {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0, retries=1)
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass
+        pg.ready_event.set()
+
+    def _plan_pg(self, pg: PGRecord) -> Optional[List[NodeID]]:
+        """Assign each bundle a node per strategy, against a scratch view."""
+        spec = pg.spec
+        scratch: Dict[NodeID, Dict[str, float]] = {
+            nid: dict(self.node_available.get(nid, {}))
+            for nid, info in self.nodes.items() if info.alive
+        }
+        assignment: List[Optional[NodeID]] = [None] * len(spec.bundles)
+
+        def fits(nid, bundle: Bundle):
+            info = self.nodes[nid]
+            if bundle.label_selector and not label_match(info.labels, bundle.label_selector):
+                return False
+            return resources_ge(scratch[nid], bundle.resources)
+
+        order = sorted(scratch.keys(), key=lambda n: n.hex())
+        if spec.strategy in ("PACK", "STRICT_PACK"):
+            # try to land everything on one node first
+            for nid in order:
+                trial = dict(scratch[nid])
+                ok = True
+                for b in spec.bundles:
+                    info = self.nodes[nid]
+                    if (b.label_selector and not label_match(info.labels, b.label_selector)) \
+                            or not resources_ge(trial, b.resources):
+                        ok = False
+                        break
+                    for k, v in b.resources.items():
+                        trial[k] = trial.get(k, 0.0) - v
+                if ok:
+                    return [nid] * len(spec.bundles)
+            if spec.strategy == "STRICT_PACK":
+                return None
+        if spec.strategy == "STRICT_SPREAD":
+            used: Set[NodeID] = set()
+            for i, b in enumerate(spec.bundles):
+                placed = False
+                for nid in order:
+                    if nid in used or not fits(nid, b):
+                        continue
+                    assignment[i] = nid
+                    used.add(nid)
+                    placed = True
+                    break
+                if not placed:
+                    return None
+            return assignment  # type: ignore[return-value]
+        # PACK fallback / SPREAD: greedy, SPREAD rotates through nodes
+        rotation = 0
+        for i, b in enumerate(spec.bundles):
+            placed = False
+            candidates = order[rotation:] + order[:rotation] if spec.strategy == "SPREAD" else order
+            for nid in candidates:
+                if fits(nid, b):
+                    assignment[i] = nid
+                    for k, v in b.resources.items():
+                        scratch[nid][k] = scratch[nid].get(k, 0.0) - v
+                    placed = True
+                    if spec.strategy == "SPREAD":
+                        rotation = (order.index(nid) + 1) % len(order)
+                    break
+            if not placed:
+                return None
+        return assignment  # type: ignore[return-value]
+
+    async def _schedule_pg(self, pg: PGRecord):
+        """2PC: prepare (reserve) on every node, then commit; cancel on any
+        failure (reference: gcs_placement_group_scheduler.h:115-118)."""
+        while pg.state in ("PENDING", "RESCHEDULING"):
+            plan = self._plan_pg(pg)
+            if plan is None:
+                await asyncio.sleep(0.5)
+                continue
+            per_node: Dict[NodeID, List[int]] = {}
+            for idx, nid in enumerate(plan):
+                per_node.setdefault(nid, []).append(idx)
+            prepared: List[NodeID] = []
+            ok = True
+            for nid, idxs in per_node.items():
+                try:
+                    reply = pickle.loads(await self.node_clients[nid].call(
+                        "PreparePGBundles", pickle.dumps({
+                            "pg_id": pg.spec.pg_id.binary(),
+                            "bundles": {i: pg.spec.bundles[i].resources for i in idxs},
+                        }), timeout=10.0))
+                    if reply.get("status") != "ok":
+                        ok = False
+                        break
+                    prepared.append(nid)
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    ok = False
+                    break
+            if not ok:
+                for nid in prepared:
+                    try:
+                        await self.node_clients[nid].call("ReleasePGBundles", pickle.dumps(
+                            {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0, retries=1)
+                    except (RpcError, asyncio.TimeoutError, OSError):
+                        pass
+                await asyncio.sleep(0.3)
+                continue
+            for nid in per_node:
+                try:
+                    await self.node_clients[nid].call("CommitPGBundles", pickle.dumps(
+                        {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0)
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass
+            pg.bundle_nodes = list(plan)
+            pg.state = "CREATED"
+            pg.ready_event.set()
+            self._publish("pgs", {"event": "created", "pg_id": pg.spec.pg_id.hex()})
+            return
+
+    # ------------------------------------------------------------------
+    # debug / state api
+    # ------------------------------------------------------------------
+
+    async def _rpc_GetState(self, req, conn):
+        return {
+            "nodes": [n.to_dict() for n in self.nodes.values()],
+            "actors": [r.info() for r in self.actors.values()],
+            "jobs": list(self.jobs.values()),
+            "num_objects_tracked": len(self.object_dir),
+            "pgs": [
+                {"pg_id": p.spec.pg_id.hex(), "state": p.state, "name": p.spec.name}
+                for p in self.pgs.values()
+            ],
+            "uptime_s": time.time() - self.start_time,
+        }
+
+
+def main():
+    import argparse
+
+    from ray_tpu._private.logs import setup_process_logging
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--address-file", required=True)
+    parser.add_argument("--log-dir", default="")
+    args = parser.parse_args()
+    setup_process_logging("gcs", args.log_dir)
+
+    async def run():
+        gcs = GcsServer(args.host, args.port)
+        addr = await gcs.start()
+        tmp = args.address_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(addr)
+        import os as _os
+
+        _os.replace(tmp, args.address_file)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

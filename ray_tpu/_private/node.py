@@ -1,0 +1,128 @@
+"""Node supervisor: starts/monitors the GCS and raylet processes.
+
+Reference: python/ray/_private/node.py (``Node`` at :52) and services.py
+process launchers (``start_gcs_server`` :1434, ``start_raylet`` :1518).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu._private.config import RAY_CONFIG
+
+
+def _preexec_die_with_parent():
+    try:
+        import ctypes
+        import signal as _signal
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, _signal.SIGKILL)  # PR_SET_PDEATHSIG
+    except Exception:
+        pass
+
+
+def _wait_for_file(path: str, timeout: float = 30.0) -> str:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                content = f.read().strip()
+            if content:
+                return content
+        time.sleep(0.02)
+    raise TimeoutError(f"service did not write {path} in {timeout}s")
+
+
+def new_session_dir() -> str:
+    root = RAY_CONFIG.session_root
+    session = os.path.join(root, f"session_{time.strftime('%Y%m%d_%H%M%S')}_{uuid.uuid4().hex[:6]}")
+    os.makedirs(os.path.join(session, "logs"), exist_ok=True)
+    latest = os.path.join(root, "session_latest")
+    try:
+        if os.path.islink(latest):
+            os.unlink(latest)
+        os.symlink(session, latest)
+    except OSError:
+        pass
+    return session
+
+
+class NodeSupervisor:
+    """Launches a head node: GCS + one raylet (plus extra raylets for tests)."""
+
+    def __init__(
+        self,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        object_store_memory: Optional[int] = None,
+    ):
+        self.resources = resources or {}
+        self.labels = labels or {}
+        self.object_store_memory = object_store_memory
+        self.session_dir = new_session_dir()
+        self.log_dir = os.path.join(self.session_dir, "logs")
+        self.processes: List[subprocess.Popen] = []
+        self.gcs_address: Optional[str] = None
+
+    def start_head(self) -> str:
+        gcs_file = os.path.join(self.session_dir, "gcs_address")
+        gcs_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.gcs",
+             "--address-file", gcs_file, "--log-dir", self.log_dir],
+            stdout=self._log("gcs_out"), stderr=subprocess.STDOUT,
+            preexec_fn=_preexec_die_with_parent,
+        )
+        self.processes.append(gcs_proc)
+        self.gcs_address = _wait_for_file(gcs_file)
+        self.start_raylet(self.resources, self.labels, is_head=True)
+        return self.gcs_address
+
+    def start_raylet(self, resources=None, labels=None, is_head=False,
+                     object_store_memory=None) -> str:
+        assert self.gcs_address
+        addr_file = os.path.join(self.session_dir, f"raylet_{uuid.uuid4().hex[:8]}")
+        cmd = [
+            sys.executable, "-m", "ray_tpu._private.raylet",
+            "--gcs-address", self.gcs_address,
+            "--resources", json.dumps(resources or {}),
+            "--labels", json.dumps(labels or {}),
+            "--log-dir", self.log_dir,
+            "--address-file", addr_file,
+        ]
+        if is_head:
+            cmd.append("--head")
+        osm = object_store_memory or self.object_store_memory
+        if osm:
+            cmd += ["--object-store-memory", str(int(osm))]
+        proc = subprocess.Popen(cmd, stdout=self._log("raylet_out"),
+                                stderr=subprocess.STDOUT,
+                                preexec_fn=_preexec_die_with_parent)
+        self.processes.append(proc)
+        return _wait_for_file(addr_file, timeout=60.0)
+
+    def _log(self, name: str):
+        return open(os.path.join(self.log_dir, f"{name}.log"), "ab")
+
+    def stop(self):
+        for proc in reversed(self.processes):
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        deadline = time.monotonic() + 3.0
+        for proc in self.processes:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        self.processes.clear()

@@ -1,0 +1,338 @@
+"""Per-node shared-memory object store (plasma equivalent).
+
+Reference: ``src/ray/object_manager/plasma`` — an immutable object store with
+create/seal/get/delete over a local protocol, LRU eviction with **spill to
+disk** (``local_object_manager.h``), and chunked node-to-node transfer
+(``object_manager/pull_manager.cc`` / ``push_manager.cc``).
+
+TPU-first deviations from the reference design:
+- segments are plain files under /dev/shm mapped with mmap (no dlmalloc arena
+  in the Python tier; the C++ arena store in ``src/object_store`` is used when
+  built — see ``ray_tpu/_private/cpp_store.py``), so host processes read
+  tensors zero-copy before feeding device transfers;
+- buffer offsets are 64-byte aligned so numpy/jax can map them directly.
+
+Blob layout inside a segment (written client-side so the store never copies):
+  [u32 magic][u64 inband_len][u32 nbuf][(u64 off, u64 len) * nbuf]
+  [inband pickle bytes][64-aligned out-of-band buffers...]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import mmap
+import os
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import RAY_CONFIG
+
+_MAGIC = 0x52545055  # 'RTPU'
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class ShmSegment:
+    """A named /dev/shm file mapping."""
+
+    def __init__(self, name: str, size: Optional[int] = None, create: bool = False):
+        self.name = name
+        self.path = f"/dev/shm/{name}"
+        if create:
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            os.ftruncate(fd, size)
+        else:
+            fd = os.open(self.path, os.O_RDWR)
+            size = os.fstat(fd).st_size
+        try:
+            self.buf = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self.size = size
+
+    def close(self):
+        try:
+            self.buf.close()
+        except (BufferError, ValueError):
+            pass  # exported memoryviews still alive; mapping freed at process exit
+
+    def unlink(self):
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+def plan_layout(inband: bytes, buffers: List[memoryview]) -> Tuple[int, List[int]]:
+    header = 4 + 8 + 4 + 16 * len(buffers)
+    off = _align(header + len(inband))
+    offsets = []
+    for b in buffers:
+        offsets.append(off)
+        off = _align(off + b.nbytes)
+    return off, offsets
+
+
+def write_blob(mem, inband: bytes, buffers: List[memoryview], offsets: List[int]):
+    header = struct.pack("<IQI", _MAGIC, len(inband), len(buffers))
+    pos = len(header)
+    mem[0:pos] = header
+    for b, off in zip(buffers, offsets):
+        mem[pos : pos + 16] = struct.pack("<QQ", off, b.nbytes)
+        pos += 16
+    mem[pos : pos + len(inband)] = inband
+    for b, off in zip(buffers, offsets):
+        flat = b if (b.format == "B" and b.ndim == 1) else b.cast("B")
+        mem[off : off + b.nbytes] = flat
+
+
+def read_blob(mem) -> Tuple[bytes, List[memoryview]]:
+    view = memoryview(mem)
+    magic, inband_len, nbuf = struct.unpack_from("<IQI", view, 0)
+    if magic != _MAGIC:
+        raise ValueError("corrupt object blob")
+    pos = 16
+    offsets = []
+    for _ in range(nbuf):
+        off, length = struct.unpack_from("<QQ", view, pos)
+        offsets.append((off, length))
+        pos += 16
+    inband = bytes(view[pos : pos + inband_len])
+    buffers = [view[off : off + length] for off, length in offsets]
+    return inband, buffers
+
+
+def pack_blob(inband: bytes, buffers: List[memoryview]) -> bytes:
+    """Serialize the same layout into a contiguous bytes (for inline/wire)."""
+    total, offsets = plan_layout(inband, buffers)
+    out = bytearray(total)
+    write_blob(out, inband, buffers, offsets)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Store server (runs inside the raylet process)
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = (
+        "state", "shm", "shm_name", "size", "last_access", "spill_path", "inline",
+    )
+
+    def __init__(self):
+        self.state = "CREATED"  # CREATED | SEALED | SPILLED
+        self.shm: Optional[ShmSegment] = None
+        self.shm_name = ""
+        self.size = 0
+        self.last_access = time.monotonic()
+        self.spill_path = ""
+        self.inline: Optional[bytes] = None
+
+
+class ObjectStoreServer:
+    """Node-local store: create/seal/get with LRU spill-to-disk eviction."""
+
+    def __init__(self, node_hex: str, capacity: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self.node_hex = node_hex
+        self.capacity = capacity or RAY_CONFIG.object_store_memory
+        self.used = 0
+        self.spill_dir = spill_dir or (RAY_CONFIG.object_spill_dir or f"/tmp/ray_tpu/spill_{node_hex[:8]}")
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self.objects: Dict[bytes, _Entry] = {}
+        self.waiters: Dict[bytes, List[asyncio.Future]] = {}
+        self.num_spilled = 0
+        self.num_restored = 0
+
+    def _shm_name(self, oid: bytes) -> str:
+        return f"rtpu_{self.node_hex[:8]}_{oid.hex()}"
+
+    def _evict_for(self, need: int) -> bool:
+        """Spill least-recently-used sealed objects until `need` bytes fit."""
+        if need > self.capacity:
+            return False
+        candidates = sorted(
+            (e.last_access, oid)
+            for oid, e in self.objects.items()
+            if e.state == "SEALED" and e.shm is not None
+        )
+        for _, oid in candidates:
+            if self.used + need <= self.capacity:
+                break
+            self._spill(oid)
+        return self.used + need <= self.capacity
+
+    def _spill(self, oid: bytes):
+        e = self.objects[oid]
+        path = os.path.join(self.spill_dir, oid.hex())
+        with open(path, "wb") as f:
+            f.write(e.shm.buf)
+        e.spill_path = path
+        e.state = "SPILLED"
+        e.shm.close()
+        e.shm.unlink()
+        e.shm = None
+        self.used -= e.size
+        self.num_spilled += 1
+
+    def _restore(self, oid: bytes) -> bool:
+        e = self.objects[oid]
+        if not self._evict_for(e.size):
+            return False
+        shm = ShmSegment(self._shm_name(oid), e.size, create=True)
+        with open(e.spill_path, "rb") as f:
+            shm.buf[:] = f.read()
+        os.unlink(e.spill_path)
+        e.shm, e.shm_name, e.spill_path = shm, shm.name, ""
+        e.state = "SEALED"
+        self.used += e.size
+        self.num_restored += 1
+        return True
+
+    # -- operations (all called on the raylet event loop) --
+
+    def create(self, oid: bytes, size: int) -> dict:
+        if oid in self.objects:
+            e = self.objects[oid]
+            return {"status": "exists", "state": e.state}
+        if not self._evict_for(size):
+            return {"status": "oom", "capacity": self.capacity}
+        e = _Entry()
+        e.size = size
+        e.shm = ShmSegment(self._shm_name(oid), size, create=True)
+        e.shm_name = e.shm.name
+        self.objects[oid] = e
+        self.used += size
+        return {"status": "ok", "shm_name": e.shm_name}
+
+    def put_inline(self, oid: bytes, blob: bytes):
+        if oid in self.objects:
+            return
+        e = _Entry()
+        e.inline = blob
+        e.size = len(blob)
+        e.state = "SEALED"
+        self.objects[oid] = e
+        self._wake(oid)
+
+    def seal(self, oid: bytes):
+        e = self.objects.get(oid)
+        if e is None:
+            raise KeyError(f"seal of unknown object {oid.hex()}")
+        e.state = "SEALED"
+        e.last_access = time.monotonic()
+        self._wake(oid)
+
+    def _wake(self, oid: bytes):
+        for fut in self.waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(True)
+
+    def contains(self, oid: bytes) -> bool:
+        e = self.objects.get(oid)
+        return e is not None and e.state in ("SEALED", "SPILLED")
+
+    async def wait_local(self, oid: bytes, timeout: float) -> bool:
+        if self.contains(oid):
+            return True
+        fut = asyncio.get_event_loop().create_future()
+        self.waiters.setdefault(oid, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def access(self, oid: bytes) -> dict:
+        """Local read: returns shm name (restoring from spill) or inline blob."""
+        e = self.objects.get(oid)
+        if e is None or e.state == "CREATED":
+            return {"status": "missing"}
+        e.last_access = time.monotonic()
+        if e.inline is not None:
+            return {"status": "inline", "blob": e.inline}
+        if e.state == "SPILLED" and not self._restore(oid):
+            return {"status": "oom"}
+        return {"status": "shm", "shm_name": e.shm_name, "size": e.size}
+
+    def read_chunk(self, oid: bytes, offset: int, length: int) -> Optional[bytes]:
+        """Remote transfer read path (works for sealed or spilled objects)."""
+        e = self.objects.get(oid)
+        if e is None or e.state == "CREATED":
+            return None
+        e.last_access = time.monotonic()
+        if e.inline is not None:
+            return e.inline[offset : offset + length]
+        if e.state == "SPILLED":
+            with open(e.spill_path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        return bytes(e.shm.buf[offset : offset + length])
+
+    def object_size(self, oid: bytes) -> Optional[int]:
+        e = self.objects.get(oid)
+        return None if e is None else e.size
+
+    def write_chunk(self, oid: bytes, offset: int, data: bytes):
+        """Pull-side write (store-mediated; remote data lands directly in shm)."""
+        e = self.objects.get(oid)
+        if e is None or e.shm is None:
+            raise KeyError(f"write_chunk on missing object {oid.hex()}")
+        e.shm.buf[offset : offset + len(data)] = data
+
+    def delete(self, oids: List[bytes]):
+        for oid in oids:
+            e = self.objects.pop(oid, None)
+            if e is None:
+                continue
+            for fut in self.waiters.pop(oid, []):
+                if not fut.done():
+                    fut.cancel()
+            if e.shm is not None:
+                self.used -= e.size
+                e.shm.close()
+                e.shm.unlink()
+            if e.spill_path:
+                try:
+                    os.unlink(e.spill_path)
+                except FileNotFoundError:
+                    pass
+
+    def stats(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "used": self.used,
+            "num_objects": len(self.objects),
+            "num_spilled": self.num_spilled,
+            "num_restored": self.num_restored,
+        }
+
+    def shutdown(self):
+        self.delete(list(self.objects.keys()))
+
+
+# ---------------------------------------------------------------------------
+# Client-side segment cache (zero-copy reads keep segments mapped)
+# ---------------------------------------------------------------------------
+
+
+class SegmentCache:
+    def __init__(self):
+        self._segments: Dict[str, ShmSegment] = {}
+
+    def open(self, name: str) -> ShmSegment:
+        seg = self._segments.get(name)
+        if seg is None:
+            seg = ShmSegment(name)
+            self._segments[name] = seg
+        return seg
+
+    def clear(self):
+        for seg in self._segments.values():
+            seg.close()
+        self._segments.clear()

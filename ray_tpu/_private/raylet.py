@@ -1,0 +1,540 @@
+"""Raylet: the per-node daemon.
+
+Reference: ``src/ray/raylet`` — ``NodeManager`` (node_manager.h:133) handling
+worker-lease requests (node_manager.cc:1820), the ``WorkerPool``
+(worker_pool.h:276) that spawns/reuses worker processes, placement-group
+bundle accounting (placement_group_resource_manager.cc), worker-death
+detection, and the node object plane: it hosts the shared-memory object store
+(plasma ``store_runner.cc``) and the pull/push transfer manager
+(``object_manager/pull_manager.cc``).
+
+Deviation from the reference: node selection for a lease happens owner-side
+via the GCS resource view (``PickNode``) rather than raylet spillback chains;
+the raylet still queues lease grants locally when resources are busy, so the
+two-level scheduler shape (cluster pick + local grant) is preserved.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+import uuid
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.common import NodeInfo, resources_add, resources_ge, resources_sub
+from ray_tpu._private.config import RAY_CONFIG
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.object_store import ObjectStoreServer
+from ray_tpu._private.rpc import RpcError, RpcServer, RetryingRpcClient
+
+logger = logging.getLogger("ray_tpu.raylet")
+
+
+def _preexec():
+    # die with the raylet (Linux): workers must not outlive their node daemon
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
+    except Exception:
+        pass
+
+
+class WorkerProc:
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.pid = proc.pid
+        self.address = ""
+        self.registered = asyncio.get_event_loop().create_future()
+        self.job_hex: Optional[str] = None
+        self.leases: Set[str] = set()
+        self.idle_since = time.monotonic()
+        self.client: Optional[RetryingRpcClient] = None
+
+
+class Raylet:
+    def __init__(
+        self,
+        gcs_address: str,
+        node_id: Optional[NodeID] = None,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        is_head: bool = False,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        log_dir: str = "",
+        object_store_memory: Optional[int] = None,
+    ):
+        self.node_id = node_id or NodeID.from_random()
+        self.gcs_address = gcs_address
+        self.is_head = is_head
+        self.log_dir = log_dir
+        self.server = RpcServer(self._handle, host, port)
+        self.gcs = RetryingRpcClient(gcs_address)
+        self.total_resources = dict(resources or {})
+        self.available = dict(self.total_resources)
+        self.labels = dict(labels or {})
+        self.store = ObjectStoreServer(self.node_id.hex(), object_store_memory)
+        self.workers: Dict[int, WorkerProc] = {}  # pid -> proc
+        self.workers_by_addr: Dict[str, WorkerProc] = {}
+        self.idle_workers: List[WorkerProc] = []
+        self.leases: Dict[str, Tuple[WorkerProc, Dict[str, float], Optional[bytes]]] = {}
+        # pg_id bytes -> bundle_idx -> (reserved, available)
+        self.pg_reserved: Dict[bytes, Dict[int, Dict[str, float]]] = {}
+        self.pg_available: Dict[bytes, Dict[int, Dict[str, float]]] = {}
+        self.pg_committed: Set[bytes] = set()
+        self._lease_waiters: List[asyncio.Future] = []
+        self._pulls: Dict[bytes, asyncio.Task] = {}
+        self._background: List[asyncio.Task] = []
+        self._spawn_env = dict(os.environ)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> str:
+        addr = await self.server.start()
+        if "CPU" not in self.total_resources:
+            self.total_resources["CPU"] = float(os.cpu_count() or 1)
+            self.available["CPU"] = self.total_resources["CPU"]
+        self._detect_tpu()
+        info = NodeInfo(
+            node_id=self.node_id,
+            address=addr,
+            object_store_address=addr,
+            total_resources=dict(self.total_resources),
+            labels=dict(self.labels),
+            is_head=self.is_head,
+        )
+        await self.gcs.call("RegisterNode", pickle.dumps({"info": info}))
+        self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._background.append(asyncio.ensure_future(self._monitor_workers_loop()))
+        logger.info("raylet %s on %s resources=%s", self.node_id.hex()[:8], addr,
+                    self.total_resources)
+        return addr
+
+    def _detect_tpu(self):
+        """TPU chip/slice detection (reference: _private/accelerators/tpu.py)."""
+        from ray_tpu.util.accelerators import detect_tpu
+
+        chips, tpu_labels = detect_tpu()
+        if chips and "TPU" not in self.total_resources:
+            self.total_resources["TPU"] = float(chips)
+            self.available["TPU"] = float(chips)
+        for k, v in tpu_labels.items():
+            self.labels.setdefault(k, v)
+
+    async def stop(self):
+        for t in self._background:
+            t.cancel()
+        for w in list(self.workers.values()):
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+        self.store.shutdown()
+        await self.server.stop()
+
+    async def _heartbeat_loop(self):
+        period = RAY_CONFIG.health_check_period_ms / 1000.0
+        while True:
+            try:
+                reply = pickle.loads(await self.gcs.call("Heartbeat", pickle.dumps({
+                    "node_id": self.node_id,
+                    "available": dict(self.available),
+                }), timeout=5.0, retries=0))
+                if reply.get("status") == "unknown_node":
+                    info = NodeInfo(
+                        node_id=self.node_id, address=self.server.address,
+                        object_store_address=self.server.address,
+                        total_resources=dict(self.total_resources),
+                        labels=dict(self.labels), is_head=self.is_head)
+                    await self.gcs.call("RegisterNode", pickle.dumps({"info": info}))
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
+            await asyncio.sleep(period)
+
+    # ------------------------------------------------------------------
+    # worker pool (reference: src/ray/raylet/worker_pool.h:276)
+    # ------------------------------------------------------------------
+
+    def _spawn_worker(self) -> WorkerProc:
+        cmd = [
+            sys.executable, "-m", "ray_tpu._private.worker_main",
+            "--raylet-address", self.server.address,
+            "--gcs-address", self.gcs_address,
+            "--node-id", self.node_id.hex(),
+            "--log-dir", self.log_dir,
+        ]
+        proc = subprocess.Popen(
+            cmd, env=self._spawn_env, preexec_fn=_preexec,
+            stdout=self._log_file("worker_stdout"), stderr=subprocess.STDOUT,
+        )
+        w = WorkerProc(proc)
+        self.workers[w.pid] = w
+        return w
+
+    def _log_file(self, name):
+        if not self.log_dir:
+            return subprocess.DEVNULL
+        os.makedirs(self.log_dir, exist_ok=True)
+        return open(os.path.join(self.log_dir, f"{name}_{self.node_id.hex()[:8]}.log"), "ab")
+
+    async def _pop_worker(self, job_hex: Optional[str]) -> WorkerProc:
+        for i, w in enumerate(self.idle_workers):
+            if w.job_hex is None or w.job_hex == job_hex:
+                self.idle_workers.pop(i)
+                w.job_hex = w.job_hex or job_hex
+                return w
+        w = self._spawn_worker()
+        await asyncio.wait_for(w.registered, RAY_CONFIG.worker_start_timeout_s)
+        w.job_hex = job_hex
+        return w
+
+    async def _rpc_RegisterWorker(self, req, conn):
+        pid = req["pid"]
+        w = self.workers.get(pid)
+        if w is None:
+            # worker started by someone else (e.g. driver-side tests); track it
+            return {"status": "unknown"}
+        w.address = req["address"]
+        self.workers_by_addr[w.address] = w
+        w.client = RetryingRpcClient(w.address)
+        if not w.registered.done():
+            w.registered.set_result(True)
+        return {"status": "ok", "node_id": self.node_id.hex()}
+
+    async def _monitor_workers_loop(self):
+        while True:
+            await asyncio.sleep(0.25)
+            for pid, w in list(self.workers.items()):
+                code = w.proc.poll()
+                if code is None:
+                    continue
+                self.workers.pop(pid, None)
+                self.workers_by_addr.pop(w.address, None)
+                if w in self.idle_workers:
+                    self.idle_workers.remove(w)
+                for lease_id in list(w.leases):
+                    self._release_lease(lease_id)
+                if w.address:
+                    logger.warning("worker %s (pid %d) exited with %s", w.address, pid, code)
+                    try:
+                        await self.gcs.call("WorkerDied", pickle.dumps({
+                            "worker_address": w.address,
+                            "node_id": self.node_id.hex(),
+                            "reason": f"exit code {code}",
+                        }), retries=2)
+                    except (RpcError, asyncio.TimeoutError, OSError):
+                        pass
+
+    # ------------------------------------------------------------------
+    # leases (reference: node_manager.cc:1820 HandleRequestWorkerLease)
+    # ------------------------------------------------------------------
+
+    def _lease_pool(self, pg: Optional[bytes], bundle_index: int):
+        if pg is not None and pg in self.pg_available:
+            bundles = self.pg_available[pg]
+            if bundle_index in bundles:
+                return bundles[bundle_index]
+            if bundle_index < 0 and bundles:
+                return bundles[min(bundles.keys())]
+        return self.available
+
+    async def _rpc_RequestWorkerLease(self, req, conn):
+        resources = req["resources"]
+        pg = req.get("pg")
+        bundle_index = req.get("bundle_index", -1)
+        job_hex = req["job_id"].hex() if req.get("job_id") is not None else None
+        deadline = time.monotonic() + RAY_CONFIG.worker_start_timeout_s
+        while True:
+            pool = self._lease_pool(pg, bundle_index)
+            if resources_ge(pool, resources):
+                resources_sub(pool, resources)
+                try:
+                    w = await self._pop_worker(job_hex)
+                except (asyncio.TimeoutError, Exception):
+                    resources_add(pool, resources)
+                    raise
+                lease_id = uuid.uuid4().hex
+                w.leases.add(lease_id)
+                # remember which pool to credit on release
+                self.leases[lease_id] = (w, resources, pickle.dumps((pg, bundle_index)))
+                return {
+                    "status": "granted",
+                    "lease_id": lease_id,
+                    "worker_address": w.address,
+                    "worker_pid": w.pid,
+                    "node_id": self.node_id.hex(),
+                }
+            if not resources_ge(self.total_resources, resources) and pg is None:
+                return {"status": "infeasible", "total": dict(self.total_resources)}
+            if time.monotonic() > deadline:
+                return {"status": "busy"}
+            fut = asyncio.get_event_loop().create_future()
+            self._lease_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+
+    def _release_lease(self, lease_id: str):
+        entry = self.leases.pop(lease_id, None)
+        if entry is None:
+            return
+        w, resources, pool_key = entry
+        pg, bundle_index = pickle.loads(pool_key)
+        pool = self._lease_pool(pg, bundle_index)
+        resources_add(pool, resources)
+        w.leases.discard(lease_id)
+        if w.pid in self.workers and not w.leases:
+            w.idle_since = time.monotonic()
+            if w not in self.idle_workers:
+                self.idle_workers.append(w)
+        for fut in self._lease_waiters:
+            if not fut.done():
+                fut.set_result(True)
+        self._lease_waiters = [f for f in self._lease_waiters if not f.done()]
+
+    async def _rpc_ReturnWorkerLease(self, req, conn):
+        self._release_lease(req["lease_id"])
+        return {"status": "ok"}
+
+    async def _rpc_KillWorker(self, req, conn):
+        w = self.workers_by_addr.get(req["worker_address"])
+        if w is None:
+            return {"status": "not_found"}
+        try:
+            w.proc.kill()
+        except Exception:
+            pass
+        return {"status": "ok"}
+
+    async def _rpc_GetNodeStats(self, req, conn):
+        return {
+            "node_id": self.node_id.hex(),
+            "total_resources": dict(self.total_resources),
+            "available": dict(self.available),
+            "num_workers": len(self.workers),
+            "num_idle": len(self.idle_workers),
+            "num_leases": len(self.leases),
+            "store": self.store.stats(),
+            "labels": dict(self.labels),
+        }
+
+    # ------------------------------------------------------------------
+    # placement group bundles (reference: placement_group_resource_manager.cc)
+    # ------------------------------------------------------------------
+
+    async def _rpc_PreparePGBundles(self, req, conn):
+        pg_id = req["pg_id"]
+        bundles: Dict[int, Dict[str, float]] = req["bundles"]
+        need: Dict[str, float] = {}
+        for res in bundles.values():
+            for k, v in res.items():
+                need[k] = need.get(k, 0.0) + v
+        if not resources_ge(self.available, need):
+            return {"status": "insufficient"}
+        resources_sub(self.available, need)
+        self.pg_reserved.setdefault(pg_id, {}).update(
+            {i: dict(r) for i, r in bundles.items()})
+        self.pg_available.setdefault(pg_id, {}).update(
+            {i: dict(r) for i, r in bundles.items()})
+        return {"status": "ok"}
+
+    async def _rpc_CommitPGBundles(self, req, conn):
+        self.pg_committed.add(req["pg_id"])
+        return {"status": "ok"}
+
+    async def _rpc_ReleasePGBundles(self, req, conn):
+        pg_id = req["pg_id"]
+        reserved = self.pg_reserved.pop(pg_id, {})
+        self.pg_available.pop(pg_id, None)
+        self.pg_committed.discard(pg_id)
+        back: Dict[str, float] = {}
+        for res in reserved.values():
+            for k, v in res.items():
+                back[k] = back.get(k, 0.0) + v
+        resources_add(self.available, back)
+        for fut in self._lease_waiters:
+            if not fut.done():
+                fut.set_result(True)
+        return {"status": "ok"}
+
+    # ------------------------------------------------------------------
+    # object store service + pull manager
+    # ------------------------------------------------------------------
+
+    async def _rpc_StoreCreate(self, req, conn):
+        return self.store.create(req["oid"], req["size"])
+
+    async def _rpc_StoreSeal(self, req, conn):
+        self.store.seal(req["oid"])
+        asyncio.ensure_future(self._announce([req["oid"]]))
+        return {"status": "ok"}
+
+    async def _rpc_StorePutInline(self, req, conn):
+        self.store.put_inline(req["oid"], req["blob"])
+        asyncio.ensure_future(self._announce([req["oid"]]))
+        return {"status": "ok"}
+
+    async def _announce(self, oids: List[bytes]):
+        try:
+            await self.gcs.call("ObjectLocAdd", pickle.dumps(
+                {"oids": oids, "node_id": self.node_id}), retries=2)
+        except (RpcError, asyncio.TimeoutError, OSError):
+            logger.warning("failed to announce %d object locations", len(oids))
+
+    async def _rpc_StoreGet(self, req, conn):
+        oid = req["oid"]
+        timeout = req.get("timeout", RAY_CONFIG.object_pull_timeout_s)
+        if not self.store.contains(oid) and req.get("pull", True):
+            self._ensure_pull(oid)
+        ok = await self.store.wait_local(oid, timeout)
+        if not ok:
+            return {"status": "timeout"}
+        return self.store.access(oid)
+
+    async def _rpc_StoreContains(self, req, conn):
+        return {"contains": self.store.contains(req["oid"])}
+
+    async def _rpc_StoreMeta(self, req, conn):
+        size = self.store.object_size(req["oid"])
+        return {"size": size}
+
+    async def _rpc_StoreFetchChunk(self, req, conn):
+        data = self.store.read_chunk(req["oid"], req["offset"], req["length"])
+        return {"data": data}
+
+    async def _rpc_StoreDelete(self, req, conn):
+        self.store.delete(req["oids"])
+        try:
+            await self.gcs.call("ObjectLocRemove", pickle.dumps(
+                {"oids": req["oids"], "node_id": self.node_id}), retries=1)
+        except (RpcError, asyncio.TimeoutError, OSError):
+            pass
+        return {"status": "ok"}
+
+    async def _rpc_StoreStats(self, req, conn):
+        return self.store.stats()
+
+    def _ensure_pull(self, oid: bytes):
+        if oid in self._pulls and not self._pulls[oid].done():
+            return
+        self._pulls[oid] = asyncio.ensure_future(self._pull(oid))
+
+    async def _pull(self, oid: bytes):
+        """Chunked transfer from a remote node's store (reference:
+        object_manager/pull_manager.cc + push_manager.cc)."""
+        deadline = time.monotonic() + RAY_CONFIG.object_pull_timeout_s
+        chunk = RAY_CONFIG.object_chunk_bytes
+        while time.monotonic() < deadline:
+            if self.store.contains(oid):
+                return
+            try:
+                reply = pickle.loads(await self.gcs.call(
+                    "ObjectLocGet", pickle.dumps({"oid": oid}), retries=2))
+            except (RpcError, asyncio.TimeoutError, OSError):
+                await asyncio.sleep(0.2)
+                continue
+            locations = [l for l in reply["locations"] if l["node_id"] != self.node_id.hex()]
+            if not locations:
+                await asyncio.sleep(0.1)
+                continue
+            src = RetryingRpcClient(locations[0]["address"])
+            try:
+                meta = pickle.loads(await src.call("StoreMeta", pickle.dumps({"oid": oid})))
+                size = meta.get("size")
+                if size is None:
+                    await asyncio.sleep(0.1)
+                    continue
+                created = self.store.create(oid, size)
+                if created["status"] == "exists":
+                    return
+                if created["status"] != "ok":
+                    logger.warning("pull %s: local store oom", oid.hex()[:12])
+                    return
+                offset = 0
+                while offset < size:
+                    n = min(chunk, size - offset)
+                    r = pickle.loads(await src.call("StoreFetchChunk", pickle.dumps(
+                        {"oid": oid, "offset": offset, "length": n})))
+                    data = r.get("data")
+                    if data is None:
+                        raise RpcError("source evicted object mid-pull")
+                    self.store.write_chunk(oid, offset, data)
+                    offset += n
+                self.store.seal(oid)
+                await self._announce([oid])
+                return
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.warning("pull %s from %s failed: %s", oid.hex()[:12],
+                               locations[0]["address"], e)
+                self.store.delete([oid])
+                await asyncio.sleep(0.2)
+            finally:
+                await src.close()
+        logger.warning("pull %s timed out", oid.hex()[:12])
+
+    # ------------------------------------------------------------------
+
+    async def _handle(self, method: str, payload: bytes, conn) -> bytes:
+        fn = getattr(self, f"_rpc_{method}", None)
+        if fn is None:
+            raise RpcError(f"raylet: unknown method {method}")
+        req = pickle.loads(payload) if payload else {}
+        resp = await fn(req, conn)
+        return pickle.dumps(resp)
+
+
+def main():
+    import argparse
+    import json
+
+    from ray_tpu._private.logs import setup_process_logging
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--head", action="store_true")
+    parser.add_argument("--node-id", default="")
+    parser.add_argument("--log-dir", default="")
+    parser.add_argument("--address-file", default="")
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    args = parser.parse_args()
+    setup_process_logging("raylet", args.log_dir)
+
+    async def run():
+        raylet = Raylet(
+            gcs_address=args.gcs_address,
+            node_id=NodeID.from_hex(args.node_id) if args.node_id else None,
+            resources=json.loads(args.resources),
+            labels=json.loads(args.labels),
+            is_head=args.head,
+            port=args.port,
+            log_dir=args.log_dir,
+            object_store_memory=args.object_store_memory or None,
+        )
+        addr = await raylet.start()
+        if args.address_file:
+            tmp = args.address_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(addr)
+            os.replace(tmp, args.address_file)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Worker process entrypoint, spawned by the raylet's worker pool.
+
+Reference: python/ray/_private/workers/default_worker.py — connects the
+embedded CoreWorker to its node's raylet + the GCS, registers, then serves
+PushTask until killed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import signal
+import threading
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--raylet-address", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--log-dir", default="")
+    args = parser.parse_args()
+
+    from ray_tpu._private.logs import setup_process_logging
+
+    setup_process_logging("worker", args.log_dir)
+
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.ids import NodeID
+
+    core = CoreWorker(
+        gcs_address=args.gcs_address,
+        raylet_address=args.raylet_address,
+        node_id=NodeID.from_hex(args.node_id),
+        is_driver=False,
+    )
+    core.current_task_id = None
+    core.current_actor_id = None
+    core.connect()
+    worker_mod._global_worker = core
+
+    import os
+
+    core._run(core.raylet.call("RegisterWorker", pickle.dumps({
+        "pid": os.getpid(), "address": core.address})))
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    while not stop.is_set():
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    main()

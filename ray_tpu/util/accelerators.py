@@ -1,0 +1,89 @@
+"""TPU accelerator detection (reference: python/ray/_private/accelerators/tpu.py).
+
+Detects chips per host and slice metadata so the raylet can advertise
+``TPU`` resources and slice labels (``TPUAcceleratorManager`` at tpu.py:267,
+pod-type inference :151). Detection order:
+
+1. explicit env overrides (``RAY_TPU_CHIPS``, ``TPU_VISIBLE_CHIPS``),
+2. GCE TPU-VM environment variables (``TPU_ACCELERATOR_TYPE``,
+   ``TPU_WORKER_ID``, set by the TPU runtime on real TPU VMs),
+3. jax device enumeration — only when ``RAY_TPU_DETECT_TPU=1``, because
+   importing jax is slow and must not happen in the raylet by default.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Tuple
+
+from ray_tpu._private.common import (
+    LABEL_TPU_POD_TYPE,
+    LABEL_TPU_SLICE,
+    LABEL_TPU_TOPOLOGY,
+    LABEL_TPU_WORKER_ID,
+)
+
+
+def _chips_for_accelerator_type(acc_type: str) -> int:
+    """Chips on THIS host for a slice of the given type (e.g. 'v5litepod-16').
+
+    v5e/v6e hosts have up to 4 chips (8 for v4/v5p with 4 dual-core chips);
+    a host never has more chips than the slice total.
+    """
+    try:
+        total = int(acc_type.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return 0
+    gen = acc_type.split("-")[0].lower()
+    per_host = 4
+    if gen in ("v2", "v3"):
+        per_host = 8
+    return min(total, per_host)
+
+
+def detect_tpu() -> Tuple[int, Dict[str, str]]:
+    """Returns (num_chips_on_host, labels)."""
+    labels: Dict[str, str] = {}
+    env_chips = os.environ.get("RAY_TPU_CHIPS") or os.environ.get("TPU_VISIBLE_CHIPS")
+    acc_type = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+    slice_name = (
+        os.environ.get("RAY_TPU_SLICE_NAME")
+        or os.environ.get("TPU_NAME")
+        or os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")[0]
+    )
+    worker_id = os.environ.get("TPU_WORKER_ID", "")
+    topology = os.environ.get("TPU_TOPOLOGY", "")
+
+    chips = 0
+    if env_chips:
+        try:
+            chips = len(env_chips.split(",")) if "," in env_chips else int(env_chips)
+        except ValueError:
+            chips = 0
+    elif acc_type:
+        chips = _chips_for_accelerator_type(acc_type)
+    elif os.environ.get("RAY_TPU_DETECT_TPU") == "1":
+        try:
+            import jax
+
+            devices = [d for d in jax.devices() if d.platform == "tpu"]
+            chips = len(devices)
+            if devices and not acc_type:
+                acc_type = getattr(devices[0], "device_kind", "tpu")
+        except Exception:
+            chips = 0
+
+    if chips:
+        if slice_name:
+            labels[LABEL_TPU_SLICE] = slice_name
+        if acc_type:
+            labels[LABEL_TPU_POD_TYPE] = acc_type
+        if worker_id:
+            labels[LABEL_TPU_WORKER_ID] = worker_id
+        if topology:
+            labels[LABEL_TPU_TOPOLOGY] = topology
+    return chips, labels
+
+
+def num_tpu_chips_on_host() -> int:
+    return detect_tpu()[0]
