@@ -309,6 +309,8 @@ class CoreWorker:
             aid = ActorID.from_hex(info["actor_id"])
             view = self._actors.get(aid)
             if view is not None:
+                if info["address"] != view.address:
+                    view.seqno = 0  # new incarnation
                 view.state = info["state"]
                 view.address = info["address"]
                 view.death_cause = info.get("death_cause", "")
@@ -805,7 +807,6 @@ class CoreWorker:
     async def _submit_actor_task_async(self, handle, method_name, args, kwargs,
                                        num_returns, task_id, refs):
         view = self._actor_view(handle.actor_id)
-        view.seqno += 1
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -816,7 +817,6 @@ class CoreWorker:
             owner_address=self.address,
             actor_id=handle.actor_id,
             method_name=method_name,
-            seqno=view.seqno,
         )
         record = {"spec": spec, "attempts": 0,
                   "max_retries": handle._max_task_retries,
@@ -844,6 +844,9 @@ class CoreWorker:
                     self._complete_error(record, TaskError(
                         "ActorDiedError: actor record missing", ""))
                     return
+                if info["address"] != view.address:
+                    # new incarnation: per-caller ordering restarts at 1
+                    view.seqno = 0
                 view.state, view.address = info["state"], info["address"]
                 if time.monotonic() > deadline:
                     self._complete_error(record, TaskError(
@@ -851,6 +854,10 @@ class CoreWorker:
                     return
                 continue
             try:
+                # seqno is assigned at push time so ordering is per-incarnation
+                # (a restarted actor's queue starts over at 1)
+                view.seqno += 1
+                spec.seqno = view.seqno
                 reply = pickle.loads(await self._worker_client(view.address).call(
                     "PushTask", pickle.dumps({"spec": spec}), timeout=86400.0, retries=0))
             except (RpcError, asyncio.TimeoutError, OSError) as e:
@@ -1061,7 +1068,8 @@ class CoreWorker:
         if spec.seqno > state["expected"]:
             ev = state["events"].setdefault(spec.seqno, asyncio.Event())
             try:
-                await asyncio.wait_for(ev.wait(), timeout=30.0)
+                # bounded grace: a gap (lost predecessor) must not wedge the queue
+                await asyncio.wait_for(ev.wait(), timeout=10.0)
             except asyncio.TimeoutError:
                 pass
         state["expected"] = max(state["expected"], spec.seqno + 1)

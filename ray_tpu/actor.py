@@ -73,7 +73,7 @@ class ActorHandle:
         return self._actor_id
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("_"):
+        if name.startswith("__"):
             raise AttributeError(name)
         if self._method_names and name not in self._method_names:
             raise AttributeError(

@@ -1,0 +1,156 @@
+"""ray_tpu.collective: collective communication between actors/tasks.
+
+Reference: python/ray/util/collective/collective.py — declare-then-rendezvous
+group management (``init_collective_group`` :182, ``create_collective_group``
+:222) and ops (``allreduce``..``barrier`` :339-736), re-based on TPU-native
+backends (see collective_group.py): XLA over ICI/DCN, and a CPU store-actor
+tier for CI.
+
+Usage inside an actor::
+
+    from ray_tpu import collective as col
+    col.init_collective_group(world_size=4, rank=self.rank, backend="cpu",
+                              group_name="grad_sync")
+    reduced = col.allreduce(my_array, group_name="grad_sync")
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ray_tpu.collective.collective_group import CollectiveStore, CpuStoreGroup, XlaGroup
+from ray_tpu.collective.types import Backend, GroupInfo, ReduceOp
+
+__all__ = [
+    "init_collective_group",
+    "create_collective_group",
+    "destroy_collective_group",
+    "get_rank",
+    "get_collective_group_size",
+    "allreduce",
+    "reduce",
+    "broadcast",
+    "allgather",
+    "reducescatter",
+    "alltoall",
+    "send",
+    "recv",
+    "barrier",
+    "ReduceOp",
+    "Backend",
+]
+
+
+class GroupManager:
+    """Per-process registry of collective groups (reference: collective.py:84)."""
+
+    def __init__(self):
+        self._groups = {}
+
+    def create(self, group_name: str, world_size: int, rank: int, backend: str,
+               devices=None):
+        backend = Backend.validate(backend)
+        if group_name in self._groups:
+            raise ValueError(f"collective group {group_name!r} already initialized")
+        if backend == Backend.CPU:
+            group = CpuStoreGroup(group_name, world_size, rank)
+        else:
+            group = XlaGroup(group_name, world_size, rank, devices=devices)
+        self._groups[group_name] = group
+        return group
+
+    def get(self, group_name: str):
+        group = self._groups.get(group_name)
+        if group is None:
+            raise ValueError(
+                f"collective group {group_name!r} is not initialized in this "
+                f"process; call init_collective_group first")
+        return group
+
+    def destroy(self, group_name: str):
+        group = self._groups.pop(group_name, None)
+        if group is not None:
+            group.destroy()
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = Backend.CPU,
+                          group_name: str = "default", devices=None):
+    """Declare this process/actor as `rank` of a collective group."""
+    return _manager.create(group_name, world_size, rank, backend, devices=devices)
+
+
+def create_collective_group(actors: List[Any], world_size: int, ranks: List[int],
+                            backend: str = Backend.CPU, group_name: str = "default"):
+    """Driver-side declaration for a set of actors (reference:
+    collective.py:222): tells each actor to init its side of the group."""
+    import ray_tpu
+
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must have equal length")
+    refs = [
+        actor._init_collective.remote(world_size, rank, backend, group_name)
+        for actor, rank in zip(actors, ranks)
+    ]
+    ray_tpu.get(refs, timeout=300)
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _manager.destroy(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get(group_name).world_size
+
+
+def allreduce(tensor, op: ReduceOp = ReduceOp.SUM, group_name: str = "default"):
+    return _manager.get(group_name).allreduce(tensor, op)
+
+
+def reduce(tensor, dst_rank: int = 0, op: ReduceOp = ReduceOp.SUM,
+           group_name: str = "default"):
+    return _manager.get(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _manager.get(group_name).broadcast(tensor, src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _manager.get(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, op: ReduceOp = ReduceOp.SUM, group_name: str = "default"):
+    return _manager.get(group_name).reducescatter(tensor, op)
+
+
+def alltoall(tensor, group_name: str = "default"):
+    return _manager.get(group_name).alltoall(tensor)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
+    return _manager.get(group_name).send(tensor, dst_rank, tag)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    return _manager.get(group_name).recv(src_rank, tag)
+
+
+def barrier(group_name: str = "default"):
+    return _manager.get(group_name).barrier()
+
+
+class CollectiveActorMixin:
+    """Mixin giving actors the `_init_collective` hook used by
+    create_collective_group."""
+
+    def _init_collective(self, world_size: int, rank: int, backend: str,
+                         group_name: str):
+        init_collective_group(world_size, rank, backend, group_name)
+        return True

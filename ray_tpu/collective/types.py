@@ -1,0 +1,41 @@
+"""Collective types (reference: python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    AVERAGE = "average"
+
+
+class Backend:
+    """Supported backends. The reference ships NCCL/gloo/NIXL
+    (collective_group/); the TPU-native set is:
+
+    - XLA: jax collectives over ICI within a slice / DCN across slices
+      (multi-controller SPMD bootstrapped by jax.distributed);
+    - CPU: a store-actor ring for CI, the analog of the reference's
+      torch-gloo CPU tier (torch_gloo_collective_group.py).
+    """
+
+    XLA = "xla"
+    CPU = "cpu"
+
+    @staticmethod
+    def validate(name: str) -> str:
+        if name not in (Backend.XLA, Backend.CPU):
+            raise ValueError(f"unknown collective backend {name!r}")
+        return name
+
+
+@dataclass
+class GroupInfo:
+    group_name: str
+    world_size: int
+    backend: str

@@ -1,0 +1,98 @@
+"""Placement groups: gang resource reservation across nodes.
+
+Reference: python/ray/util/placement_group.py + GCS 2PC scheduling
+(``gcs_placement_group_scheduler.h:115-118``). Strategies: PACK, SPREAD,
+STRICT_PACK, STRICT_SPREAD; bundles may carry label selectors — the hook TPU
+slice gang scheduling builds on (``ray_tpu/util/tpu.py``).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+from ray_tpu._private.common import Bundle, PlacementGroupSpec
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu.exceptions import PlacementGroupError
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]]):
+        self.id = pg_id
+        self.bundle_specs = bundles
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def ready(self, timeout: float = 300.0) -> bool:
+        from ray_tpu._private import worker as worker_mod
+
+        core = worker_mod.global_worker()
+        reply = core._run(core._gcs_call("WaitPlacementGroupReady", {
+            "pg_id": self.id.binary(), "timeout": timeout}, timeout=timeout + 10))
+        if reply["status"] == "ready":
+            return True
+        if reply["status"] == "timeout":
+            return False
+        raise PlacementGroupError(f"placement group state: {reply['status']}")
+
+    def wait(self, timeout_seconds: float = 300.0) -> bool:
+        return self.ready(timeout_seconds)
+
+    def bundle_nodes(self) -> List[str]:
+        from ray_tpu._private import worker as worker_mod
+
+        core = worker_mod.global_worker()
+        info = core._run(core._gcs_call("GetPlacementGroup",
+                                        {"pg_id": self.id.binary()}))["info"]
+        return info["bundle_nodes"] if info else []
+
+    def __reduce__(self):
+        return (_rebuild_pg, (self.id.binary(), self.bundle_specs))
+
+
+def _rebuild_pg(id_bytes, bundles):
+    return PlacementGroup(PlacementGroupID(id_bytes), bundles)
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: str = "ref_counted",
+    bundle_label_selector: Optional[List[Dict[str, str]]] = None,
+) -> PlacementGroup:
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        raise ValueError(f"unknown strategy {strategy!r}")
+    if not bundles:
+        raise ValueError("placement group needs at least one bundle")
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod.global_worker()
+    selectors = bundle_label_selector or [{}] * len(bundles)
+    spec = PlacementGroupSpec(
+        pg_id=PlacementGroupID.from_random(),
+        bundles=[Bundle(resources=dict(b), label_selector=dict(s))
+                 for b, s in zip(bundles, selectors)],
+        strategy=strategy,
+        name=name,
+        lifetime=lifetime,
+        creator_job=core.job_id,
+    )
+    core._run(core._gcs_call("CreatePlacementGroup", {"spec": spec}))
+    return PlacementGroup(spec.pg_id, [dict(b) for b in bundles])
+
+
+def remove_placement_group(pg: PlacementGroup):
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod.global_worker()
+    core._run(core._gcs_call("RemovePlacementGroup", {"pg_id": pg.id.binary()}))
+
+
+def get_placement_group_state(pg: PlacementGroup) -> Optional[dict]:
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod.global_worker()
+    return core._run(core._gcs_call("GetPlacementGroup", {"pg_id": pg.id.binary()}))["info"]

@@ -1,0 +1,97 @@
+"""TPU slice gang scheduling.
+
+Reference: python/ray/util/tpu.py (``SlicePlacementGroup`` :52,
+``slice_placement_group`` :227) and ``reserve_tpu_slice``
+(_private/accelerators/tpu.py:213): two-step reserve — pick an ICI-connected
+slice by its slice-name label, then create a STRICT_SPREAD placement group
+whose bundles are pinned to that slice's hosts, so a training job's workers
+land on one slice and all collective traffic rides ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private.common import LABEL_TPU_POD_TYPE, LABEL_TPU_SLICE
+from ray_tpu.exceptions import PlacementGroupError
+from ray_tpu.util.placement_group import PlacementGroup, placement_group
+
+
+def available_slices() -> Dict[str, List[dict]]:
+    """Alive nodes grouped by slice name label."""
+    import ray_tpu
+
+    slices: Dict[str, List[dict]] = {}
+    for node in ray_tpu.nodes():
+        if not node["alive"]:
+            continue
+        name = node["labels"].get(LABEL_TPU_SLICE)
+        if name:
+            slices.setdefault(name, []).append(node)
+    return slices
+
+
+def reserve_tpu_slice(num_hosts: int, pod_type: Optional[str] = None) -> Optional[str]:
+    """Pick a slice with >= num_hosts TPU hosts (and matching pod type).
+
+    Reference: reserve_tpu_slice (_private/accelerators/tpu.py:213) — probes
+    hosts for their slice name and returns one suitable for gang scheduling.
+    """
+    for name, nodes in sorted(available_slices().items()):
+        if len(nodes) < num_hosts:
+            continue
+        if pod_type and any(
+            n["labels"].get(LABEL_TPU_POD_TYPE) not in (pod_type, None) for n in nodes
+        ):
+            continue
+        return name
+    return None
+
+
+class SlicePlacementGroup:
+    """A placement group spanning every host of one reserved TPU slice."""
+
+    def __init__(self, pg: PlacementGroup, slice_name: str, num_hosts: int,
+                 chips_per_host: int):
+        self.placement_group = pg
+        self.slice_name = slice_name
+        self.num_hosts = num_hosts
+        self.chips_per_host = chips_per_host
+
+    @property
+    def num_chips(self) -> int:
+        return self.num_hosts * self.chips_per_host
+
+    def ready(self, timeout: float = 300.0) -> bool:
+        return self.placement_group.ready(timeout)
+
+
+def slice_placement_group(
+    num_hosts: int,
+    chips_per_host: Optional[int] = None,
+    pod_type: Optional[str] = None,
+    extra_bundle_resources: Optional[Dict[str, float]] = None,
+) -> SlicePlacementGroup:
+    """Reserve a slice and gang-schedule one bundle per host on it.
+
+    Reference: slice_placement_group (util/tpu.py:227) — bundle label selector
+    on the slice-name key so the whole group lands on ICI-connected hosts.
+    """
+    slice_name = reserve_tpu_slice(num_hosts, pod_type)
+    if slice_name is None:
+        raise PlacementGroupError(
+            f"no TPU slice with {num_hosts} hosts available"
+            + (f" (pod_type={pod_type})" if pod_type else ""))
+    nodes = available_slices()[slice_name]
+    if chips_per_host is None:
+        chips_per_host = int(min(n["total_resources"].get("TPU", 0) for n in nodes) or 1)
+    bundle = {"TPU": float(chips_per_host), "CPU": 1.0}
+    if extra_bundle_resources:
+        bundle.update(extra_bundle_resources)
+    pg = placement_group(
+        bundles=[dict(bundle) for _ in range(num_hosts)],
+        strategy="STRICT_SPREAD",
+        bundle_label_selector=[{LABEL_TPU_SLICE: slice_name}] * num_hosts,
+        name=f"tpu-slice-{slice_name}",
+    )
+    return SlicePlacementGroup(pg, slice_name, num_hosts, chips_per_host)

@@ -1,0 +1,5 @@
+"""Framework utilities (jax bootstrap, timing, tree helpers)."""
+
+from ray_tpu.utils.jaxtools import import_jax, jax_platform_forced
+
+__all__ = ["import_jax", "jax_platform_forced"]

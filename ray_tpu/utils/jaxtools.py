@@ -1,0 +1,33 @@
+"""Central jax import: honors RAY_TPU_JAX_PLATFORMS before backends init.
+
+Some environments force a platform plugin (e.g. a tunneled TPU) regardless of
+``JAX_PLATFORMS``; the test tier must still run on a virtual CPU mesh. Every
+framework module that needs jax goes through :func:`import_jax`, which applies
+the ``RAY_TPU_JAX_PLATFORMS`` override via ``jax.config`` exactly once, before
+any backend is initialized.
+"""
+
+from __future__ import annotations
+
+import os
+
+_applied = False
+
+
+def jax_platform_forced() -> str:
+    return os.environ.get("RAY_TPU_JAX_PLATFORMS", "")
+
+
+def import_jax():
+    global _applied
+    import jax
+
+    if not _applied:
+        plat = jax_platform_forced()
+        if plat:
+            try:
+                jax.config.update("jax_platforms", plat)
+            except Exception:
+                pass
+        _applied = True
+    return jax

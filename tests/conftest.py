@@ -5,10 +5,15 @@ tests run on xla_force_host_platform_device_count=8 so CI needs no TPUs.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["RAY_TPU_JAX_PLATFORMS"] = "cpu"  # honored by ray_tpu.utils.import_jax
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+from ray_tpu.utils import import_jax  # noqa: E402
+
+import_jax()  # apply the platform override before any test touches jax
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,123 @@
+"""Collective tests: CPU store tier across actor processes + XLA tier on the
+virtual 8-device mesh (reference: util/collective/tests/* CPU tiers,
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective.types import ReduceOp
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(num_cpus=1)
+class Peer:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def _init_collective(self, world_size, rank, backend, group_name):
+        from ray_tpu import collective as col
+
+        col.init_collective_group(world_size, rank, backend, group_name)
+        return True
+
+    def do_allreduce(self):
+        from ray_tpu import collective as col
+
+        out = col.allreduce(np.full((4,), float(self.rank + 1)))
+        return out
+
+    def do_broadcast(self):
+        from ray_tpu import collective as col
+
+        return col.broadcast(np.full((3,), float(self.rank)), src_rank=1)
+
+    def do_allgather(self):
+        from ray_tpu import collective as col
+
+        return col.allgather(np.array([self.rank]))
+
+    def do_reducescatter(self):
+        from ray_tpu import collective as col
+
+        return col.reducescatter(np.arange(4, dtype=np.float64))
+
+    def do_sendrecv(self):
+        from ray_tpu import collective as col
+
+        if self.rank == 0:
+            col.send(np.array([42.0]), dst_rank=1)
+            return None
+        return col.recv(src_rank=0)
+
+
+def test_cpu_collective_ops(cluster):
+    from ray_tpu import collective as col
+
+    world = 2
+    peers = [Peer.remote(r, world) for r in range(world)]
+    col.create_collective_group(peers, world, list(range(world)), backend="cpu")
+
+    out = ray_tpu.get([p.do_allreduce.remote() for p in peers], timeout=120)
+    np.testing.assert_allclose(out[0], np.full((4,), 3.0))
+    np.testing.assert_allclose(out[1], np.full((4,), 3.0))
+
+    out = ray_tpu.get([p.do_broadcast.remote() for p in peers], timeout=120)
+    np.testing.assert_allclose(out[0], np.full((3,), 1.0))
+
+    out = ray_tpu.get([p.do_allgather.remote() for p in peers], timeout=120)
+    assert [int(x[0]) for x in out[0]] == [0, 1]
+
+    out = ray_tpu.get([p.do_reducescatter.remote() for p in peers], timeout=120)
+    np.testing.assert_allclose(out[0], np.array([0.0, 2.0]))
+    np.testing.assert_allclose(out[1], np.array([4.0, 6.0]))
+
+    out = ray_tpu.get([p.do_sendrecv.remote() for p in peers], timeout=120)
+    np.testing.assert_allclose(out[1], np.array([42.0]))
+
+    for p in peers:
+        ray_tpu.kill(p)
+
+
+def test_xla_group_single_process():
+    """XLA backend over the virtual 8-device CPU mesh: ops lower to XLA
+    collectives exactly as they would over ICI."""
+    from ray_tpu.collective.collective_group import XlaGroup
+
+    import jax
+
+    group = XlaGroup("g", world_size=8, rank=0)
+    x = np.arange(8, dtype=np.float32)
+
+    out = group.allreduce(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((8,), 28.0))
+
+    out = group.allreduce(x, ReduceOp.MAX)
+    np.testing.assert_allclose(np.asarray(out), np.full((8,), 7.0))
+
+    rs = group.reducescatter(np.ones((8,), np.float32))
+    np.testing.assert_allclose(np.asarray(rs), np.full((8,), 8.0))
+
+    bc = group.broadcast(np.arange(8, dtype=np.float32), src_rank=3)
+    np.testing.assert_allclose(np.asarray(bc), np.full((8,), 3.0))
+
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    pp = group.ppermute(np.arange(8, dtype=np.float32), perm)
+    np.testing.assert_allclose(np.asarray(pp), np.roll(np.arange(8), 1))
+
+    # global (64,): member d holds [8d, 8d+8); all-to-all transposes blocks
+    a2a = np.asarray(group.alltoall(np.arange(64, dtype=np.float32)))
+    expect = np.arange(64).reshape(8, 8).T.reshape(-1)
+    np.testing.assert_allclose(a2a, expect)
+
+    ag = np.asarray(group.allgather(np.arange(8, dtype=np.float32)))
+    np.testing.assert_allclose(ag[:8], np.arange(8.0))
+    assert ag.shape == (64,)
