@@ -1,0 +1,122 @@
+"""Flagship benchmark: transformer LM train-step MFU on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The north-star target (BASELINE.md) is >=35% MFU on the fine-tune path;
+``vs_baseline`` is measured MFU / 0.35 (so 1.0 == target met). The reference
+publishes no tokens/sec constants (BASELINE.json `published` is empty), so
+the MFU target is the comparison axis.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+# bf16 peak FLOP/s per chip by generation (v5e default; override via env)
+PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5": 459e12,  # v5p
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_for(kind: str) -> float:
+    env = os.environ.get("RAY_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    kind = (kind or "").lower().replace(" ", "")
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main() -> int:
+    t_start = time.time()
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models import CONFIGS
+        from ray_tpu.parallel import TrainStepBundle, create_mesh, make_optimizer
+
+        devices = jax.devices()
+        on_tpu = any("tpu" in str(d.platform).lower() or "TPU" in str(d)
+                     for d in devices)
+        dev_kind = getattr(devices[0], "device_kind", "")
+
+        if on_tpu:
+            config_name = os.environ.get("RAY_TPU_BENCH_CONFIG", "125m")
+            batch, seq = int(os.environ.get("RAY_TPU_BENCH_BATCH", "8")), 2048
+            steps, warmup = 10, 3
+            peak = _peak_for(str(dev_kind) or str(devices[0]))
+        else:  # CI fallback: tiny on CPU so the bench always emits a line
+            config_name, batch, seq, steps, warmup = "tiny", 4, 128, 3, 1
+            peak = 1e12
+
+        cfg = CONFIGS[config_name]
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, max_seq_len=seq)
+        mesh = create_mesh({"data": 1, "fsdp": 1, "seq": 1, "tensor": 1},
+                           devices=devices[:1])
+        bundle = TrainStepBundle(cfg, mesh, optimizer=make_optimizer(
+            learning_rate=1e-4, warmup_steps=10, total_steps=1000))
+        params, opt_state = bundle.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        batch_data = bundle.make_batch(rng, batch, seq)
+
+        for _ in range(warmup):
+            params, opt_state, loss = bundle.step(params, opt_state, batch_data)
+        jax.block_until_ready(loss)
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = bundle.step(params, opt_state, batch_data)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / steps
+
+        tokens_per_step = batch * seq
+        tokens_per_sec = tokens_per_step / dt
+        # 6N matmul flops + attention term, per token
+        flops_per_token = 6.0 * cfg.num_params() + 12.0 * cfg.n_layers * cfg.d_model * seq
+        mfu = tokens_per_sec * flops_per_token / peak
+
+        result = {
+            "metric": f"train_mfu_{config_name}",
+            "value": round(mfu, 4),
+            "unit": "mfu_fraction",
+            "vs_baseline": round(mfu / 0.35, 4),
+            "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+            "step_time_s": round(dt, 4),
+            "loss": round(float(loss), 4),
+            "device": str(devices[0]),
+            "config": config_name,
+            "batch": batch,
+            "seq": seq,
+            "wall_s": round(time.time() - t_start, 1),
+        }
+        print(json.dumps(result))
+        return 0
+    except Exception as e:  # always emit a parseable line
+        import traceback
+
+        print(json.dumps({
+            "metric": "train_mfu_125m",
+            "value": 0.0,
+            "unit": "mfu_fraction",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+            "traceback": traceback.format_exc()[-2000:],
+        }))
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""Model zoo: the flagship decoder LM plus small nets for RL/vision tests."""
+
+from ray_tpu.models.transformer import (
+    CONFIGS,
+    Transformer,
+    TransformerConfig,
+    lm_loss,
+)
+
+__all__ = ["Transformer", "TransformerConfig", "CONFIGS", "lm_loss"]

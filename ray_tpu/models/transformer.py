@@ -1,0 +1,234 @@
+"""Flagship decoder-only transformer LM (llama-family), TPU-first.
+
+This is the model the framework's north-star path trains (SURVEY.md §3.4):
+GSPMD-sharded via logical axis annotations so one definition serves DP, FSDP,
+TP, and SP meshes (reference capability: Ray delegates model parallelism to
+torch; here it is native — flax linen + ``nn.with_logical_partitioning``).
+
+Design notes for the MXU:
+- all matmuls are bf16 with fp32 accumulation (``preferred_element_type``);
+- weights are stored fp32 (master) and cast to the compute dtype per step;
+- attention goes through ``ray_tpu.ops.attention`` (pallas flash kernel on
+  TPU, pure-jax fallback elsewhere);
+- remat policy checkpoints per block to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops.attention import attention as attention_op
+
+# Logical axis names used across the parallel layer (see
+# ray_tpu/parallel/mesh.py for the logical->mesh rules).
+BATCH = "batch"
+SEQ = "seq"
+EMBED = "embed"
+HEADS = "heads"
+KV_HEADS = "kv_heads"
+HEAD_DIM = "head_dim"
+MLP = "mlp"
+VOCAB = "vocab"
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 1408
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attention_impl: str = "auto"  # auto | flash | xla
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        per_layer = (
+            d * d  # q
+            + 2 * d * (self.n_kv_heads * self.head_dim)  # k, v
+            + d * d  # o
+            + 3 * d * f  # gate, up, down
+            + 2 * d  # norms
+        )
+        return v * d + self.n_layers * per_layer + d + (0 if self.tie_embeddings else d * v)
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (fwd+bwd ~ 6*N + attention)."""
+        return 6.0 * self.num_params() + 12.0 * self.n_layers * self.d_model * self.max_seq_len
+
+
+# preset configs (name -> config); "tiny" is the CI/test config
+CONFIGS = {
+    "tiny": TransformerConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                              n_kv_heads=2, d_ff=128, max_seq_len=128, remat=False),
+    "125m": TransformerConfig(vocab_size=32000, d_model=768, n_layers=12, n_heads=12,
+                              n_kv_heads=12, d_ff=2048, max_seq_len=2048),
+    "350m": TransformerConfig(vocab_size=32000, d_model=1024, n_layers=24, n_heads=16,
+                              n_kv_heads=16, d_ff=2816, max_seq_len=2048),
+    "1b": TransformerConfig(vocab_size=32000, d_model=2048, n_layers=16, n_heads=16,
+                            n_kv_heads=8, d_ff=5632, max_seq_len=2048),
+    "7b": TransformerConfig(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+                            n_kv_heads=32, d_ff=11008, max_seq_len=4096),
+}
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding over the last dim (pairs)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (..., S, half)
+    cos = jnp.cos(angles)[:, :, None, :]  # (B, S, 1, half)
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale", nn.with_logical_partitioning(nn.initializers.ones, ("embed",)),
+            (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        hd = cfg.head_dim
+        dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
+            features=feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02 / np.sqrt(2 * cfg.n_layers)), axes),
+        )
+        q = dense((cfg.n_heads, hd), ("embed", "heads", "head_dim"), "q_proj")(x)
+        k = dense((cfg.n_kv_heads, hd), ("embed", "kv_heads", "head_dim"), "k_proj")(x)
+        v = dense((cfg.n_kv_heads, hd), ("embed", "kv_heads", "head_dim"), "v_proj")(x)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        out = attention_op(q, k, v, causal=True, impl=cfg.attention_impl,
+                           segment_ids=segment_ids)
+        out = nn.DenseGeneral(
+            features=cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="o_proj",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02 / np.sqrt(2 * cfg.n_layers)),
+                ("heads", "head_dim", "embed")),
+        )(out)
+        return out
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, axes, name: nn.DenseGeneral(  # noqa: E731
+            features=feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name=name,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02 / np.sqrt(2 * cfg.n_layers)), axes),
+        )
+        gate = dense(cfg.d_ff, ("embed", "mlp"), "gate_proj")(x)
+        up = dense(cfg.d_ff, ("embed", "mlp"), "up_proj")(x)
+        hidden = nn.silu(gate) * up
+        return nn.DenseGeneral(
+            features=cfg.d_model, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="down_proj",
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02 / np.sqrt(2 * cfg.n_layers)), ("mlp", "embed")),
+        )(hidden)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        h = x + Attention(cfg, name="attn")(
+            RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions, segment_ids)
+        h = nn.with_logical_constraint(h, ("batch", "seq", "embed"))
+        out = h + MLP(cfg, name="mlp")(RMSNorm(dtype=cfg.dtype, name="mlp_norm")(h))
+        return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM. __call__ returns logits (B, S, V)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, segment_ids=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :].astype(jnp.int32)
+            positions = jnp.broadcast_to(positions, tokens.shape)
+        embed = self.param(
+            "embed", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        x = embed.astype(cfg.dtype)[tokens]
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False,
+                             policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"layer_{i}")(x, positions, segment_ids)
+        x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))
+        else:
+            head = self.param(
+                "lm_head", nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("embed", "vocab")),
+                (cfg.d_model, cfg.vocab_size), cfg.param_dtype)
+            logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
+        return nn.with_logical_constraint(logits, ("batch", "seq", "vocab"))
+
+
+def lm_loss(logits: jax.Array, targets: jax.Array,
+            mask: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token cross entropy; `targets` are the inputs shifted by one."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return nll.mean()

@@ -1,0 +1,12 @@
+"""TPU ops: pallas kernels + jax fallbacks (attention, ring attention, fused)."""
+
+from ray_tpu.ops.attention import attention, flash_attention, reference_attention
+from ray_tpu.ops.ring_attention import ring_attention, ulysses_attention
+
+__all__ = [
+    "attention",
+    "flash_attention",
+    "reference_attention",
+    "ring_attention",
+    "ulysses_attention",
+]

@@ -1,0 +1,151 @@
+"""Attention ops: pallas TPU flash-attention forward + reference path.
+
+The MXU-friendly hot op of the flagship model. The pallas kernel implements
+the standard online-softmax flash pattern (one (batch*head, q-block) program,
+fori_loop over k-blocks held in VMEM); the backward pass recomputes with the
+reference implementation (flash-bwd kernel is a later-round optimization —
+rematerialized bwd keeps HBM usage flat at the cost of one extra forward).
+
+CI runs the kernel in pallas interpret mode on CPU (SURVEY.md §4 implication:
+every accelerator feature needs a hardware-free tier).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def reference_attention(q, k, v, causal: bool = True,
+                        segment_ids: Optional[jax.Array] = None):
+    """Pure-XLA attention: (B, S, H, D) -> (B, S, H, D), fp32 softmax."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    S = q.shape[1]
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        scores = jnp.where(seg_mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# pallas flash forward
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal,
+                      sm_scale, seq_len):
+    import jax.experimental.pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    q_blk = pl.program_id(1)
+    d = q.shape[-1]
+
+    nk = seq_len // block_k
+    if causal:
+        # only k-blocks up to (and including) the diagonal block
+        upper = jnp.minimum(((q_blk + 1) * block_q + block_k - 1) // block_k, nk)
+    else:
+        upper = nk
+
+    def body(i, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = q_blk * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m = jnp.full((block_q, 1), -1e30, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc, m, l))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention_fwd(q, k, v, causal: bool = True, block_q: int = 128,
+                        block_k: int = 128, interpret: bool = False):
+    """(B, S, H, D) flash forward via pallas (TPU) / interpret mode (CI)."""
+    import jax.experimental.pallas as pl
+
+    B, S, H, D = q.shape
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    assert S % block_q == 0 and S % block_k == 0, "seq must divide block sizes"
+    sm_scale = 1.0 / (D ** 0.5)
+    # (B, S, H, D) -> (B*H, S, D)
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        sm_scale=sm_scale, seq_len=S)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = True, interpret: bool = False):
+    return flash_attention_fwd(q, k, v, causal=causal, interpret=interpret)
+
+
+def _fa_fwd(q, k, v, causal, interpret):
+    return flash_attention_fwd(q, k, v, causal=causal, interpret=interpret), (q, k, v)
+
+
+def _fa_bwd(causal, interpret, res, g):
+    q, k, v = res
+    # rematerialized backward through the reference path (correct, HBM-flat)
+    _, vjp = jax.vjp(lambda q_, k_, v_: reference_attention(q_, k_, v_, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def attention(q, k, v, causal: bool = True, impl: str = "auto",
+              segment_ids: Optional[jax.Array] = None):
+    """Dispatching attention op used by the flagship model."""
+    if impl == "auto":
+        use_flash = (
+            jax.default_backend() == "tpu"
+            and segment_ids is None
+            and q.shape[1] % 128 == 0
+            and q.shape[-1] in (64, 128, 256)
+        )
+        impl = "flash" if use_flash else "xla"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal)
+    if impl == "flash_interpret":
+        return flash_attention(q, k, v, causal, True)
+    return reference_attention(q, k, v, causal, segment_ids)

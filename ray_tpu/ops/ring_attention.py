@@ -1,0 +1,89 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context sequence/context parallelism is first-class here (the reference
+implements none — SURVEY.md §5 — delegating to user code; the TPU framework
+provides it natively). Q/K/V live sharded over the ``seq`` mesh axis; each
+step computes one block of the online-softmax accumulation and rotates K/V
+around the ring with ``ppermute`` — ICI-neighbor traffic only, the canonical
+TPU pattern (cf. PAPERS.md ring-attention lineage).
+
+Use inside shard_map (see ray_tpu/parallel/context.py for the wrapper) — the
+body is pure jnp + lax collectives, so it is CPU-mesh testable and fuses
+under jit on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, q_off, k_off, causal, sm_scale):
+    """One (local_q x remote_k) block: returns (scores_exp@v, max, sumexp)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * sm_scale,
+                   k.astype(jnp.float32))
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_off + jnp.arange(sq)[:, None]
+        k_pos = k_off + jnp.arange(sk)[None, :]
+        s = jnp.where((q_pos >= k_pos)[None, None], s, -1e30)
+    m = s.max(axis=-1, keepdims=True)  # (b,h,q,1)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return pv, m, l
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Exact attention with K/V rotating around `axis_name`.
+
+    Args: q, k, v of local shape (B, S_local, H, D), sharded over seq.
+    Returns local (B, S_local, H, D).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    sm_scale = 1.0 / (D ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    acc = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m = jnp.full((B, H, Sq, 1), -1e30, jnp.float32)
+    l = jnp.zeros((B, H, Sq, 1), jnp.float32)
+
+    def step(carry, step_idx):
+        acc, m, l, k_cur, v_cur = carry
+        k_owner = (idx - step_idx) % n
+        pv, m_blk, l_blk = _block_attn(
+            q, k_cur, v_cur, idx * Sq, k_owner * k_cur.shape[1], causal, sm_scale)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        acc_new = acc * alpha + pv * beta
+        l_new = l * alpha + l_blk * beta
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc_new, m_new, l_new, k_next, v_next), None
+
+    (acc, m, l, _, _), _ = jax.lax.scan(step, (acc, m, l, k, v), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
+    """DeepSpeed-Ulysses style sequence parallelism: all-to-all reshards
+    (B, S/n, H, D) -> (B, S, H/n, D), runs full attention on the head shard,
+    then reshards back. Requires H % n == 0.
+    """
+    from ray_tpu.ops.attention import reference_attention
+
+    n = jax.lax.psum(1, axis_name)
+
+    def a2a(x, split_axis, concat_axis):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
+    qg = a2a(q, 2, 1)  # heads split, seq gathered
+    kg = a2a(k, 2, 1)
+    vg = a2a(v, 2, 1)
+    out = reference_attention(qg, kg, vg, causal=causal)
+    return a2a(out, 1, 2)

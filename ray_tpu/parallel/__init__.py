@@ -1,0 +1,20 @@
+"""Parallelism library: meshes, logical rules, GSPMD train step, SP/CP."""
+
+from ray_tpu.parallel.mesh import (
+    AXES,
+    LOGICAL_RULES,
+    create_mesh,
+    default_mesh_axes,
+    named_sharding,
+)
+from ray_tpu.parallel.train import TrainStepBundle, make_optimizer
+
+__all__ = [
+    "AXES",
+    "LOGICAL_RULES",
+    "create_mesh",
+    "default_mesh_axes",
+    "named_sharding",
+    "TrainStepBundle",
+    "make_optimizer",
+]

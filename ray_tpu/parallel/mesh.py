@@ -1,0 +1,78 @@
+"""Device meshes and logical sharding rules.
+
+The framework's parallelism vocabulary (the idiomatic superset of what the
+reference delegates to torch — SURVEY.md §7 step 7):
+
+- ``data``: pure data parallel (batch)
+- ``fsdp``: data parallel with parameter sharding (ZeRO-3/GSPMD style)
+- ``seq``: sequence/context parallelism (ring attention / Ulysses)
+- ``tensor``: megatron-style tensor parallelism (heads / mlp / vocab)
+- ``expert``: MoE expert parallelism
+
+A mesh is just ``jax.sharding.Mesh`` over these named axes; logical axis
+names used by the models map onto mesh axes via LOGICAL_RULES, and XLA
+inserts the collectives (psum/all-gather/reduce-scatter over ICI) implied by
+the shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu.utils import import_jax
+
+AXES = ("data", "fsdp", "seq", "tensor")
+
+# logical axis -> mesh axis (or tuple) mapping; None = replicated
+LOGICAL_RULES = (
+    ("batch", ("data", "fsdp")),
+    ("seq", "seq"),
+    ("embed", "fsdp"),
+    ("heads", "tensor"),
+    ("kv_heads", "tensor"),
+    ("head_dim", None),
+    ("mlp", "tensor"),
+    ("vocab", "tensor"),
+    ("expert", "expert"),
+)
+
+
+def create_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
+    """Build a Mesh with named axes; sizes must multiply to #devices."""
+    jax = import_jax()
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    total = int(np.prod(list(axes.values()))) if axes else 1
+    if total != len(devs):
+        raise ValueError(f"mesh axes {axes} need {total} devices, have {len(devs)}")
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    return Mesh(np.array(devs).reshape(shape), names)
+
+
+def default_mesh_axes(n_devices: int) -> Dict[str, int]:
+    """A sensible decomposition for n devices: tensor within host-ICI reach,
+    fsdp for the rest (pure-dp kept 1; scale dp across slices via DCN)."""
+    tensor = 1
+    for cand in (8, 4, 2):
+        if n_devices % cand == 0 and n_devices >= cand * 2:
+            tensor = cand
+            break
+    if n_devices <= 4:
+        tensor = 1
+    return {"data": 1, "fsdp": n_devices // tensor, "seq": 1, "tensor": tensor}
+
+
+def logical_to_mesh_sharding(logical_spec_tree, mesh, rules=LOGICAL_RULES):
+    import flax.linen as nn
+
+    return nn.logical_to_mesh_sharding(logical_spec_tree, mesh, list(rules))
+
+
+def named_sharding(mesh, *spec):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(*spec))

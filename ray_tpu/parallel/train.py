@@ -1,0 +1,107 @@
+"""GSPMD training step for the flagship model.
+
+Builds the jitted train step the Train layer runs on every host (SURVEY.md
+§3.4 — the reference only launches processes; here the compute path is part
+of the framework): optax optimizer, bf16 compute / fp32 params, logical
+shardings resolved against the mesh so DP/FSDP/TP/SP all come from the same
+definition.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.models.transformer import Transformer, TransformerConfig, lm_loss
+from ray_tpu.parallel.mesh import LOGICAL_RULES, logical_to_mesh_sharding
+from ray_tpu.utils import import_jax
+
+
+def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
+                   warmup_steps: int = 100, total_steps: int = 10000,
+                   b1: float = 0.9, b2: float = 0.95, clip: float = 1.0):
+    import optax
+
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+class TrainStepBundle:
+    """Everything a training worker needs: init fn, step fn, shardings."""
+
+    def __init__(self, cfg: TransformerConfig, mesh, optimizer=None,
+                 rules=LOGICAL_RULES, donate: bool = True):
+        jax = import_jax()
+        import flax.linen as nn
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.cfg = cfg
+        self.mesh = mesh
+        self.model = Transformer(cfg)
+        self.optimizer = optimizer or make_optimizer()
+        self.rules = rules
+
+        def init_fn(rng):
+            B, S = 1, min(cfg.max_seq_len, 128)
+            tokens = jax.numpy.zeros((B, S), dtype=jax.numpy.int32)
+            params = self.model.init(rng, tokens)["params"]
+            opt_state = self.optimizer.init(params)
+            return params, opt_state
+
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        logical = nn.get_partition_spec(abstract)
+        shardings = logical_to_mesh_sharding(logical, mesh, rules)
+        self.param_shardings, self.opt_shardings = shardings
+        self.batch_sharding = NamedSharding(mesh, P(("data", "fsdp"), "seq"))
+        self.repl = NamedSharding(mesh, P())
+
+        self.init = jax.jit(init_fn, out_shardings=shardings)
+
+        def loss_fn(params, tokens, targets, mask):
+            logits = self.model.apply({"params": params}, tokens)
+            return lm_loss(logits, targets, mask)
+
+        def train_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, batch["tokens"], batch["targets"], batch.get("mask"))
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            import optax
+
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        donate_args = (0, 1) if donate else ()
+        self.step = jax.jit(
+            train_step,
+            in_shardings=(self.param_shardings, self.opt_shardings,
+                          {"tokens": self.batch_sharding,
+                           "targets": self.batch_sharding,
+                           "mask": self.batch_sharding}),
+            out_shardings=(self.param_shardings, self.opt_shardings, self.repl),
+            donate_argnums=donate_args,
+        )
+
+        def eval_step(params, batch):
+            logits = self.model.apply({"params": params}, batch["tokens"])
+            return lm_loss(logits, batch["targets"], batch.get("mask"))
+
+        self.eval_step = jax.jit(eval_step)
+
+    def make_batch(self, rng: np.random.Generator, batch_size: int, seq_len: int):
+        """Synthetic LM batch (tokens/targets/mask) laid out for the mesh."""
+        jax = import_jax()
+
+        tokens = rng.integers(0, self.cfg.vocab_size, (batch_size, seq_len + 1),
+                              dtype=np.int32)
+        batch = {
+            "tokens": tokens[:, :-1],
+            "targets": tokens[:, 1:],
+            "mask": np.ones((batch_size, seq_len), np.float32),
+        }
+        return {k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()}

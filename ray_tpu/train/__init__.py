@@ -1,0 +1,40 @@
+"""ray_tpu.train: distributed training orchestration (reference: ray.train v2).
+
+The north-star path (SURVEY.md §3.4): JaxTrainer.fit() -> TrainController
+actor -> WorkerGroup gang-scheduled on a TPU slice -> jax.distributed mesh ->
+user train loop with report(metrics, checkpoint) -> CheckpointManager, with
+worker-group restart from the latest checkpoint on failure.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TrainingFailedError
+
+__all__ = [
+    "JaxTrainer",
+    "DataParallelTrainer",
+    "TrainingFailedError",
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "Result",
+    "Checkpoint",
+    "CheckpointManager",
+    "report",
+    "get_context",
+    "get_checkpoint",
+    "get_dataset_shard",
+]

@@ -1,0 +1,111 @@
+"""Checkpoints + top-K retention.
+
+Reference: ray.train.Checkpoint (directory handle) and ``CheckpointManager``
+(train/v2/_internal/execution/checkpoint/checkpoint_manager.py:71) persisting
+through a storage context (execution/storage.py:312). Round 1 storage is a
+filesystem path (local or NFS/gcsfuse mount); orbax handles the array state
+inside the directory (see ray_tpu/train/orbax_utils.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+
+class Checkpoint:
+    """A directory of checkpoint artifacts."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: str) -> str:
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Tracks reported checkpoints under <storage>/<run>/checkpoint_NNNNNN,
+    keeps top-K by the configured score attribute."""
+
+    def __init__(self, run_dir: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.run_dir = run_dir
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self.index = 0
+        self.records: List[Dict[str, Any]] = []
+        os.makedirs(run_dir, exist_ok=True)
+        self._load_state()
+
+    def _state_path(self) -> str:
+        return os.path.join(self.run_dir, "checkpoint_manager.json")
+
+    def _load_state(self):
+        try:
+            with open(self._state_path()) as f:
+                state = json.load(f)
+            self.index = state["index"]
+            self.records = state["records"]
+        except (FileNotFoundError, json.JSONDecodeError, KeyError):
+            pass
+
+    def _save_state(self):
+        with open(self._state_path(), "w") as f:
+            json.dump({"index": self.index, "records": self.records}, f)
+
+    def register(self, source_dir: str, metrics: Dict[str, Any]) -> Checkpoint:
+        """Persist a worker-reported checkpoint directory into the run dir."""
+        self.index += 1
+        dest = os.path.join(self.run_dir, f"checkpoint_{self.index:06d}")
+        if os.path.abspath(source_dir) != dest:
+            shutil.copytree(source_dir, dest, dirs_exist_ok=True)
+        self.records.append({"path": dest, "metrics": metrics, "time": time.time()})
+        self._prune()
+        self._save_state()
+        return Checkpoint(dest)
+
+    def _prune(self):
+        if self.num_to_keep is None or len(self.records) <= self.num_to_keep:
+            return
+        if self.score_attribute:
+            sign = 1 if self.score_order == "max" else -1
+            ranked = sorted(
+                self.records,
+                key=lambda r: sign * float(r["metrics"].get(self.score_attribute, 0.0)),
+                reverse=True)
+            keep = ranked[: self.num_to_keep]
+        else:
+            keep = self.records[-self.num_to_keep:]
+        for rec in self.records:
+            if rec not in keep:
+                shutil.rmtree(rec["path"], ignore_errors=True)
+        self.records = [r for r in self.records if r in keep]
+
+    def latest(self) -> Optional[Checkpoint]:
+        return Checkpoint(self.records[-1]["path"]) if self.records else None
+
+    def best(self) -> Optional[Checkpoint]:
+        if not self.records:
+            return None
+        if not self.score_attribute:
+            return self.latest()
+        sign = 1 if self.score_order == "max" else -1
+        rec = max(self.records,
+                  key=lambda r: sign * float(r["metrics"].get(self.score_attribute, 0.0)))
+        return Checkpoint(rec["path"])

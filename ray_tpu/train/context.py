@@ -1,0 +1,95 @@
+"""Per-worker train context: rank info + report plumbing.
+
+Reference: ray.train.get_context() / ray.train.report (train/v2 api);
+``report(metrics, checkpoint=...)`` ships the checkpoint to storage and
+notifies the controller (checkpoint/report_handler in the reference).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_ctx = threading.local()
+
+
+class TrainContext:
+    def __init__(self, rank: int, world_size: int, local_rank: int,
+                 node_rank: int, controller, latest_checkpoint: Optional[Checkpoint],
+                 config: Optional[Dict[str, Any]] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self.controller = controller
+        self.latest_checkpoint = latest_checkpoint
+        self.config = config or {}
+        self.dataset_shards = dataset_shards or {}
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.latest_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self.dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(f"no dataset shard named {name!r}")
+        return shard
+
+
+def set_context(ctx: Optional[TrainContext]):
+    _ctx.value = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_ctx, "value", None)
+    if ctx is None:
+        raise RuntimeError("not inside a train worker (ray_tpu.train loop)")
+    return ctx
+
+
+def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+    """Report metrics (all ranks) and optionally a checkpoint (rank 0 ships
+    it to storage via the controller; other ranks' checkpoints are ignored in
+    round 1 — single-writer checkpoint layout)."""
+    import shutil
+    import uuid
+
+    import ray_tpu
+
+    ctx = get_context()
+    ckpt_dir = None
+    if checkpoint is not None and ctx.rank == 0:
+        # stage into the (shared) run dir so the controller can adopt it even
+        # if this worker's scratch space vanishes
+        run_dir = getattr(ctx, "run_dir", None)
+        src = checkpoint.as_directory()
+        if run_dir:
+            ckpt_dir = f"{run_dir}/staged_{uuid.uuid4().hex[:8]}"
+            shutil.copytree(src, ckpt_dir, dirs_exist_ok=True)
+        else:
+            ckpt_dir = src
+    ray_tpu.get(ctx.controller._on_report.remote(ctx.rank, metrics, ckpt_dir),
+                timeout=300)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_context().get_dataset_shard(name)

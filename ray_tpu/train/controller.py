@@ -1,0 +1,108 @@
+"""TrainController: the actor orchestrating one training run.
+
+Reference: train/v2/_internal/execution/controller/controller.py:100 — a
+state machine that creates the worker group, polls it, applies the failure
+policy (kill group -> recreate -> resume from latest checkpoint), and owns
+the checkpoint manager.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
+
+
+@ray_tpu.remote
+class TrainController:
+    """max_concurrency > 1 so _on_report lands while run() blocks."""
+
+    def __init__(self, fn_blob: bytes, config: Optional[dict],
+                 scaling: ScalingConfig, run_config: RunConfig,
+                 run_dir: str, shards_per_rank: Optional[List[bytes]] = None):
+        self.fn_blob = fn_blob
+        self.config = config
+        self.scaling = scaling
+        self.run_config = run_config
+        self.run_dir = run_dir
+        self.shards_per_rank = shards_per_rank
+        ckpt_cfg = run_config.checkpoint_config
+        self.manager = CheckpointManager(
+            run_dir, ckpt_cfg.num_to_keep, ckpt_cfg.checkpoint_score_attribute,
+            ckpt_cfg.checkpoint_score_order)
+        self._lock = threading.Lock()
+        self.latest_metrics: Dict[str, Any] = {}
+        self.state = "INITIALIZING"
+        self._self_handle = None
+
+    def _set_self(self, handle):
+        self._self_handle = handle
+        return True
+
+    def _on_report(self, rank: int, metrics: Dict[str, Any],
+                   staged_ckpt_dir: Optional[str]) -> bool:
+        with self._lock:
+            if rank == 0:
+                self.latest_metrics = dict(metrics)
+            if staged_ckpt_dir:
+                self.manager.register(staged_ckpt_dir, metrics)
+                import shutil
+
+                shutil.rmtree(staged_ckpt_dir, ignore_errors=True)
+        return True
+
+    def status(self) -> Dict[str, Any]:
+        return {"state": self.state, "metrics": dict(self.latest_metrics)}
+
+    def run(self) -> Dict[str, Any]:
+        from ray_tpu.train.worker_group import WorkerGroup
+
+        failures = 0
+        max_failures = self.run_config.failure_config.max_failures
+        last_error = None
+        while True:
+            self.state = "SCHEDULING"
+            group = WorkerGroup(self.scaling)
+            try:
+                bootstrap = self.scaling.bootstrap_distributed
+                if bootstrap is None:
+                    bootstrap = self.scaling.use_tpu and self.scaling.num_workers > 1
+                if bootstrap and self.scaling.num_workers > 1:
+                    group.bootstrap_distributed()
+                self.state = "RUNNING"
+                refs = group.run(self.fn_blob, self.config, self._self_handle,
+                                 self.manager.latest(), self.run_dir,
+                                 self.shards_per_rank)
+                results = ray_tpu.get(refs, timeout=24 * 3600)
+                self.state = "FINISHED"
+                latest = self.manager.latest()
+                return {
+                    "metrics": self.latest_metrics or (
+                        results[0].get("result") if isinstance(results[0], dict)
+                        else {}),
+                    "checkpoint_path": latest.path if latest else None,
+                    "error": None,
+                }
+            except TaskError as e:
+                last_error = str(e)
+                failures += 1
+                self.state = "RESTARTING"
+                if failures > max_failures:
+                    latest = self.manager.latest()
+                    self.state = "ERRORED"
+                    return {
+                        "metrics": self.latest_metrics,
+                        "checkpoint_path": latest.path if latest else None,
+                        "error": f"train workers failed {failures}x "
+                                 f"(max_failures={max_failures}): {last_error[:2000]}",
+                    }
+                time.sleep(1.0)
+            finally:
+                group.shutdown()

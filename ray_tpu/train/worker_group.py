@@ -1,0 +1,175 @@
+"""Training worker group: N actors gang-scheduled on a PG / TPU slice.
+
+Reference: train/v2/_internal/execution/worker_group/worker_group.py:104 —
+actors created in a placement group (SPREAD across hosts), each running the
+user's train loop; the TPU path reserves an ICI slice first
+(callbacks/tpu_reservation_callback.py:9 -> util/tpu.py slice PG).
+
+TPU runtime ownership note (SURVEY.md §7 hard part (c)): exactly one process
+per host may own the TPU, and a process that initialized jax.distributed
+cannot re-form a smaller mesh — so the group always kills its workers on
+shutdown/restart and re-creates fresh actor processes.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.context import TrainContext, set_context
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.util.placement_group import PlacementGroup, placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@ray_tpu.remote
+class TrainWorker:
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self._distributed = False
+
+    def get_host_info(self) -> Dict[str, Any]:
+        return {
+            "hostname": socket.gethostname(),
+            "ip": "127.0.0.1",
+            "node_id": ray_tpu.get_runtime_context().get_node_id(),
+            "pid": os.getpid(),
+        }
+
+    def find_free_port(self) -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def setup_distributed(self, coordinator: str, num_processes: int,
+                          process_id: int) -> bool:
+        """jax.distributed bootstrap (reference: train/v2/jax/config.py:41
+        _setup_jax_tpu_environment -> jax.distributed.initialize)."""
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        self._distributed = True
+        return True
+
+    def run(self, fn_blob: bytes, config: Optional[dict], controller,
+            latest_checkpoint_path: Optional[str], run_dir: str,
+            dataset_shard_blob: Optional[bytes]) -> Dict[str, Any]:
+        fn = cloudpickle.loads(fn_blob)
+        shards = cloudpickle.loads(dataset_shard_blob) if dataset_shard_blob else {}
+        ctx = TrainContext(
+            rank=self.rank,
+            world_size=self.world_size,
+            local_rank=0,
+            node_rank=self.rank,
+            controller=controller,
+            latest_checkpoint=(Checkpoint(latest_checkpoint_path)
+                               if latest_checkpoint_path else None),
+            config=config,
+            dataset_shards=shards,
+        )
+        ctx.run_dir = run_dir
+        set_context(ctx)
+        try:
+            if config is not None:
+                result = fn(config)
+            else:
+                result = fn()
+            return {"rank": self.rank, "result": result}
+        finally:
+            set_context(None)
+
+    def shutdown(self):
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, scaling: ScalingConfig, name_prefix: str = "train"):
+        self.scaling = scaling
+        self.workers: List[Any] = []
+        self.pg: Optional[PlacementGroup] = None
+        self.slice_pg = None
+        self._create()
+
+    def _create(self):
+        n = self.scaling.num_workers
+        if self.scaling.use_tpu:
+            from ray_tpu.util.tpu import slice_placement_group
+
+            try:
+                self.slice_pg = slice_placement_group(
+                    num_hosts=n, pod_type=self.scaling.topology,
+                    chips_per_host=self.scaling.chips_per_worker or None)
+                self.slice_pg.ready(timeout=600)
+                self.pg = self.slice_pg.placement_group
+            except Exception:
+                self.pg = None  # fall through to plain PG
+        if self.pg is None:
+            self.pg = placement_group(
+                [self.scaling.bundle() for _ in range(n)],
+                strategy=self.scaling.placement_strategy
+                if self.scaling.placement_strategy in
+                ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD") else "SPREAD")
+            self.pg.ready(timeout=600)
+        res = self.scaling.bundle()
+        self.workers = [
+            TrainWorker.options(
+                num_cpus=res.get("CPU", 1.0),
+                num_tpus=res.get("TPU", 0.0),
+                resources={k: v for k, v in res.items() if k not in ("CPU", "TPU")},
+                scheduling_strategy=PlacementGroupSchedulingStrategy(self.pg, i),
+                max_restarts=0,
+            ).remote(i, n)
+            for i in range(n)
+        ]
+        # make sure every worker is alive before proceeding
+        ray_tpu.get([w.get_host_info.remote() for w in self.workers], timeout=600)
+
+    def bootstrap_distributed(self):
+        """Form the jax.distributed mesh across all workers (rank 0 hosts the
+        coordinator)."""
+        infos = ray_tpu.get([w.get_host_info.remote() for w in self.workers],
+                            timeout=300)
+        port = ray_tpu.get(self.workers[0].find_free_port.remote(), timeout=60)
+        coordinator = f"{infos[0]['ip']}:{port}"
+        refs = [
+            w.setup_distributed.remote(coordinator, len(self.workers), i)
+            for i, w in enumerate(self.workers)
+        ]
+        ray_tpu.get(refs, timeout=600)
+
+    def run(self, fn_blob, config, controller, latest_ckpt, run_dir, shards_per_rank):
+        return [
+            w.run.remote(fn_blob, config, controller,
+                         latest_ckpt.path if latest_ckpt else None, run_dir,
+                         shards_per_rank[i] if shards_per_rank else None)
+            for i, w in enumerate(self.workers)
+        ]
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        if self.pg is not None:
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            self.pg = None

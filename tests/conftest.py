@@ -11,6 +11,12 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
+# let spawned worker processes import functions defined in test modules
+_tests_dir = os.path.dirname(os.path.abspath(__file__))
+_pp = os.environ.get("PYTHONPATH", "")
+if _tests_dir not in _pp.split(":"):
+    os.environ["PYTHONPATH"] = f"{_tests_dir}:{_pp}" if _pp else _tests_dir
+
 from ray_tpu.utils import import_jax  # noqa: E402
 
 import_jax()  # apply the platform override before any test touches jax
