@@ -98,5 +98,5 @@ _d("train_health_check_period_s", 1.0)
 _d("serve_proxy_port", 8000)
 
 # --- logging / session ---
-_d("session_root", "/tmp/ray_tpu")
+_d("session_root", "/tmp/ray_tpu_sessions")
 _d("log_to_driver", True)

@@ -963,10 +963,13 @@ class CoreWorker:
             return await self._exec_actor_task(spec)
         return await self._exec_normal_task(spec)
 
-    def _ensure_pool(self, size: int):
+    def _ensure_pool(self, size: int, replace: bool = False):
         from concurrent.futures import ThreadPoolExecutor
 
-        if self._exec_pool is None:
+        if self._exec_pool is None or (
+                replace and self._exec_pool._max_workers < size):
+            # a reused worker may carry a smaller pool from its task-executing
+            # past; an actor with max_concurrency needs the full width
             self._exec_pool = ThreadPoolExecutor(max_workers=size,
                                                  thread_name_prefix="ray_tpu-exec")
 
@@ -1041,7 +1044,7 @@ class CoreWorker:
         cls = await self._fetch_function(spec.function_key)
         args, kwargs = await self._resolve_args(spec.args_blob)
         opts = spec.actor_options
-        self._ensure_pool(max(1, opts.max_concurrency))
+        self._ensure_pool(max(1, opts.max_concurrency), replace=True)
         self.actor_id = spec.actor_id
 
         def _create():

@@ -142,7 +142,7 @@ class ObjectStoreServer:
         self.node_hex = node_hex
         self.capacity = capacity or RAY_CONFIG.object_store_memory
         self.used = 0
-        self.spill_dir = spill_dir or (RAY_CONFIG.object_spill_dir or f"/tmp/ray_tpu/spill_{node_hex[:8]}")
+        self.spill_dir = spill_dir or (RAY_CONFIG.object_spill_dir or f"/tmp/ray_tpu_sessions/spill_{node_hex[:8]}")
         os.makedirs(self.spill_dir, exist_ok=True)
         self.objects: Dict[bytes, _Entry] = {}
         self.waiters: Dict[bytes, List[asyncio.Future]] = {}

@@ -1,0 +1,30 @@
+"""ray_tpu.data: distributed datasets (reference: ray.data).
+
+Lazy per-block task execution over the shared-memory object store; feeds
+per-host TPU input pipelines via iter_batches / Train dataset sharding.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import (
+    Dataset,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "Dataset",
+    "Block",
+    "BlockAccessor",
+    "range",
+    "from_items",
+    "from_pandas",
+    "from_numpy",
+    "read_parquet",
+    "read_csv",
+    "read_json",
+]

@@ -1,0 +1,82 @@
+"""Blocks: the unit of data movement (reference: python/ray/data/block.py).
+
+A block is a pyarrow Table (tabular path, zero-copy through the object
+store's out-of-band buffers) or a plain Python list (object path). Batches
+surface as dicts of numpy arrays (the format TPU input pipelines consume).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+Block = Union["pyarrow.Table", List[Any]]  # noqa: F821
+
+
+def _pa():
+    import pyarrow
+
+    return pyarrow
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self.block = block
+
+    @staticmethod
+    def build_from_rows(rows: List[Any]) -> Block:
+        """Rows of dicts -> arrow table; anything else -> list block."""
+        if rows and all(isinstance(r, dict) for r in rows):
+            try:
+                return _pa().Table.from_pylist(rows)
+            except Exception:
+                return list(rows)
+        return list(rows)
+
+    @staticmethod
+    def build_from_batch(batch: Dict[str, np.ndarray]) -> Block:
+        cols = {k: np.asarray(v) for k, v in batch.items()}
+        try:
+            return _pa().Table.from_pydict({k: v.tolist() if v.ndim > 1 else v
+                                            for k, v in cols.items()})
+        except Exception:
+            n = len(next(iter(cols.values())))
+            return [{k: v[i] for k, v in cols.items()} for i in range(n)]
+
+    def num_rows(self) -> int:
+        return self.block.num_rows if self._is_arrow() else len(self.block)
+
+    def _is_arrow(self) -> bool:
+        return hasattr(self.block, "column_names")
+
+    def to_rows(self) -> List[Any]:
+        if self._is_arrow():
+            return self.block.to_pylist()
+        return list(self.block)
+
+    def to_batch(self) -> Dict[str, np.ndarray]:
+        if self._is_arrow():
+            return {name: np.asarray(self.block.column(name).to_numpy(
+                zero_copy_only=False)) for name in self.block.column_names}
+        if self.block and all(isinstance(r, dict) for r in self.block):
+            keys = self.block[0].keys()
+            return {k: np.asarray([r[k] for r in self.block]) for k in keys}
+        return {"item": np.asarray(self.block, dtype=object)}
+
+    def slice(self, start: int, end: int) -> Block:
+        if self._is_arrow():
+            return self.block.slice(start, end - start)
+        return self.block[start:end]
+
+    def to_pandas(self):
+        if self._is_arrow():
+            return self.block.to_pandas()
+        import pandas as pd
+
+        return pd.DataFrame(self.to_rows())
+
+    def size_bytes(self) -> int:
+        if self._is_arrow():
+            return self.block.nbytes
+        return sum(64 for _ in self.block)  # rough

@@ -1,0 +1,409 @@
+"""Dataset: lazy logical plan -> distributed block execution.
+
+Reference: python/ray/data — ``Dataset`` (data/dataset.py) holding a logical
+plan executed by a streaming executor (_internal/execution/streaming_executor
+.py:66) as per-block tasks over object-store refs (RefBundle). Round-1
+architecture notes:
+
+- map-family ops chain per-block remote tasks WITHOUT barriers (each block
+  streams through the whole op chain; the object store backpressures via its
+  capacity + spill);
+- repartition / random_shuffle / split are barrier ops;
+- blocks live in the shared-memory object store; iteration pulls refs one at
+  a time so only a window of blocks is resident in the driver.
+"""
+
+from __future__ import annotations
+
+import builtins
+import functools
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import cloudpickle
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+# ---------------------------------------------------------------------------
+# remote block transforms (execute on workers)
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote(num_cpus=1)
+def _produce_block(thunk_blob: bytes) -> Block:
+    thunk = cloudpickle.loads(thunk_blob)
+    return thunk()
+
+
+@ray_tpu.remote(num_cpus=1)
+def _apply_chain(chain_blob: bytes, block: Block) -> Block:
+    """Applies a list of (kind, fn) stages to one block."""
+    chain = cloudpickle.loads(chain_blob)
+    for kind, fn, batch_size in chain:
+        acc = BlockAccessor(block)
+        if kind == "map_rows":
+            block = BlockAccessor.build_from_rows([fn(r) for r in acc.to_rows()])
+        elif kind == "flat_map":
+            out: List[Any] = []
+            for r in acc.to_rows():
+                out.extend(fn(r))
+            block = BlockAccessor.build_from_rows(out)
+        elif kind == "filter":
+            block = BlockAccessor.build_from_rows(
+                [r for r in acc.to_rows() if fn(r)])
+        elif kind == "map_batches":
+            n = acc.num_rows()
+            bs = batch_size or n or 1
+            outs = []
+            for start in builtins.range(0, n, bs):
+                batch = BlockAccessor(acc.slice(start, min(start + bs, n))).to_batch()
+                result = fn(batch)
+                outs.append(BlockAccessor.build_from_batch(result)
+                            if isinstance(result, dict)
+                            else BlockAccessor.build_from_rows(list(result)))
+            rows: List[Any] = []
+            for b in outs:
+                rows.extend(BlockAccessor(b).to_rows())
+            block = BlockAccessor.build_from_rows(rows)
+        else:
+            raise ValueError(kind)
+    return block
+
+
+@ray_tpu.remote(num_cpus=1)
+def _merge_blocks(*blocks: Block) -> Block:
+    rows: List[Any] = []
+    for b in blocks:
+        rows.extend(BlockAccessor(b).to_rows())
+    return BlockAccessor.build_from_rows(rows)
+
+
+@ray_tpu.remote(num_cpus=1)
+def _slice_block(block: Block, start: int, end: int) -> Block:
+    return BlockAccessor(block).slice(start, end)
+
+
+@ray_tpu.remote(num_cpus=1)
+def _count_block(block: Block) -> int:
+    return BlockAccessor(block).num_rows()
+
+
+@ray_tpu.remote(num_cpus=1)
+def _write_parquet_block(block: Block, path: str, index: int) -> str:
+    import os
+
+    import pyarrow.parquet as pq
+
+    acc = BlockAccessor(block)
+    table = acc.block if acc._is_arrow() else None
+    if table is None:
+        import pyarrow as pa
+
+        table = pa.Table.from_pylist(acc.to_rows())
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.parquet")
+    pq.write_table(table, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# logical plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Plan:
+    # source thunks (each produces one block) OR upstream materialized refs
+    source_thunks: List[bytes] = field(default_factory=list)
+    source_refs: Optional[List[Any]] = None
+    chain: List[tuple] = field(default_factory=list)  # (kind, fn, batch_size)
+    barrier: Optional[tuple] = None  # applied after chain
+    parent: Optional["_Plan"] = None
+
+
+class Dataset:
+    def __init__(self, plan: _Plan):
+        self._plan = plan
+        self._materialized: Optional[List[Any]] = None
+
+    # -- transforms (lazy) --
+
+    def _extend(self, stage: tuple) -> "Dataset":
+        p = self._plan
+        newp = _Plan(source_thunks=p.source_thunks, source_refs=p.source_refs,
+                     chain=p.chain + [stage], barrier=p.barrier, parent=p.parent)
+        return Dataset(newp)
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._extend(("map_rows", fn, None))
+
+    def flat_map(self, fn: Callable[[Any], Iterable[Any]]) -> "Dataset":
+        return self._extend(("flat_map", fn, None))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._extend(("filter", fn, None))
+
+    def map_batches(self, fn: Callable[[Dict[str, np.ndarray]], Any],
+                    batch_size: Optional[int] = None, **_) -> "Dataset":
+        return self._extend(("map_batches", fn, batch_size))
+
+    # -- barriers --
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        refs = self._execute()
+        rows_total = sum(ray_tpu.get([_count_block.remote(r) for r in refs],
+                                     timeout=600))
+        merged = _merge_blocks.remote(*refs) if len(refs) > 1 else refs[0]
+        per = max(1, math.ceil(rows_total / max(num_blocks, 1)))
+        new_refs = [
+            _slice_block.remote(merged, i * per, min((i + 1) * per, rows_total))
+            for i in builtins.range(num_blocks)
+            if i * per < rows_total or i == 0
+        ]
+        return Dataset(_Plan(source_refs=new_refs))
+
+    def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
+        refs = self._execute()
+        nblocks = max(len(refs), 1)
+
+        def _shuffle(block, seed=seed):
+            rows = BlockAccessor(block).to_rows()
+            rng = np.random.default_rng(seed)
+            perm = rng.permutation(len(rows))
+            return BlockAccessor.build_from_rows([rows[i] for i in perm])
+
+        merged = _merge_blocks.remote(*refs) if len(refs) > 1 else refs[0]
+        shuffled = _apply_chain.remote(
+            cloudpickle.dumps([("map_batches",
+                                lambda b, s=seed: _shuffle_batch(b, s), None)]),
+            merged)
+        ds = Dataset(_Plan(source_refs=[shuffled]))
+        return ds.repartition(nblocks) if nblocks > 1 else ds
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return Dataset(_Plan(source_refs=self._execute() + other._execute()))
+
+    def limit(self, n: int) -> "Dataset":
+        rows = []
+        for row in self.iter_rows():
+            rows.append(row)
+            if len(rows) >= n:
+                break
+        return from_items(rows, parallelism=1)
+
+    def split(self, n: int) -> List["Dataset"]:
+        """Equal row-count splits (used by Train dataset sharding)."""
+        refs = self._execute()
+        counts = ray_tpu.get([_count_block.remote(r) for r in refs], timeout=600)
+        total = sum(counts)
+        per = total // n
+        merged = _merge_blocks.remote(*refs) if len(refs) > 1 else refs[0]
+        out = []
+        for i in builtins.range(n):
+            start = i * per
+            end = (i + 1) * per if i < n - 1 else total
+            out.append(Dataset(_Plan(source_refs=[
+                _slice_block.remote(merged, start, end)])))
+        return out
+
+    # -- execution --
+
+    def _execute(self) -> List[Any]:
+        if self._materialized is not None:
+            return self._materialized
+        p = self._plan
+        if p.source_refs is not None:
+            refs = list(p.source_refs)
+        else:
+            refs = [_produce_block.remote(t) for t in p.source_thunks]
+        if p.chain:
+            blob = cloudpickle.dumps(p.chain)
+            refs = [_apply_chain.remote(blob, r) for r in refs]
+        self._materialized = refs
+        return refs
+
+    def materialize(self) -> "Dataset":
+        self._execute()
+        return self
+
+    # -- consumption --
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref in self._execute():
+            yield ray_tpu.get(ref, timeout=600)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor(block).to_rows()
+
+    def iter_batches(self, batch_size: int = 256,
+                     drop_last: bool = False) -> Iterator[Dict[str, np.ndarray]]:
+        carry: List[Any] = []
+        for block in self.iter_blocks():
+            carry.extend(BlockAccessor(block).to_rows())
+            while len(carry) >= batch_size:
+                chunk, carry = carry[:batch_size], carry[batch_size:]
+                yield BlockAccessor(BlockAccessor.build_from_rows(chunk)).to_batch()
+        if carry and not drop_last:
+            yield BlockAccessor(BlockAccessor.build_from_rows(carry)).to_batch()
+
+    def take(self, n: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        refs = self._execute()
+        return sum(ray_tpu.get([_count_block.remote(r) for r in refs], timeout=600))
+
+    def num_blocks(self) -> int:
+        return len(self._execute())
+
+    def schema(self):
+        for block in self.iter_blocks():
+            acc = BlockAccessor(block)
+            if acc._is_arrow():
+                return acc.block.schema
+            rows = acc.to_rows()
+            if rows:
+                return type(rows[0])
+        return None
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        frames = [BlockAccessor(b).to_pandas() for b in self.iter_blocks()]
+        return pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
+
+    def write_parquet(self, path: str) -> List[str]:
+        refs = self._execute()
+        return ray_tpu.get([
+            _write_parquet_block.remote(r, path, i) for i, r in enumerate(refs)
+        ], timeout=600)
+
+    def __repr__(self):
+        return f"Dataset(blocks={len(self._materialized) if self._materialized else '?'})"
+
+
+def _shuffle_batch(batch: Dict[str, np.ndarray], seed) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    n = len(next(iter(batch.values()))) if batch else 0
+    perm = rng.permutation(n)
+    return {k: np.asarray(v)[perm] for k, v in batch.items()}
+
+
+# ---------------------------------------------------------------------------
+# sources (reference: python/ray/data/read_api.py)
+# ---------------------------------------------------------------------------
+
+
+def _make_dataset(thunks: List[Callable[[], Block]]) -> Dataset:
+    return Dataset(_Plan(source_thunks=[cloudpickle.dumps(t) for t in thunks]))
+
+
+def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
+    parallelism = max(1, min(parallelism, n or 1))
+    per = math.ceil(n / parallelism)
+    thunks = []
+    for i in builtins.range(parallelism):
+        start, end = i * per, min((i + 1) * per, n)
+        if start >= end:
+            continue
+        thunks.append(functools.partial(_range_block, start, end))
+    return _make_dataset(thunks)
+
+
+def _range_block(start: int, end: int) -> Block:
+    return BlockAccessor.build_from_rows(
+        [{"id": i} for i in builtins.range(start, end)])
+
+
+def from_items(items: List[Any], parallelism: int = 8) -> Dataset:
+    items = list(items)
+    parallelism = max(1, min(parallelism, len(items) or 1))
+    per = math.ceil(len(items) / parallelism)
+    thunks = []
+    for i in builtins.range(parallelism):
+        chunk = items[i * per:(i + 1) * per]
+        if chunk:
+            thunks.append(functools.partial(BlockAccessor.build_from_rows, chunk))
+    return _make_dataset(thunks)
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    table = pa.Table.from_pandas(df)
+    return _make_dataset([lambda t=table: t])
+
+
+def from_numpy(arr: np.ndarray) -> Dataset:
+    return from_items([{"data": row} for row in arr])
+
+
+def read_parquet(paths, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths, (".parquet",))
+    thunks = [functools.partial(_read_parquet_file, f) for f in files]
+    return _make_dataset(thunks)
+
+
+def _read_parquet_file(path: str) -> Block:
+    import pyarrow.parquet as pq
+
+    return pq.read_table(path)
+
+
+def read_csv(paths, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths, (".csv",))
+    thunks = [functools.partial(_read_csv_file, f) for f in files]
+    return _make_dataset(thunks)
+
+
+def _read_csv_file(path: str) -> Block:
+    from pyarrow import csv as pacsv
+
+    return pacsv.read_csv(path)
+
+
+def read_json(paths, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths, (".json", ".jsonl"))
+    thunks = [functools.partial(_read_json_file, f) for f in files]
+    return _make_dataset(thunks)
+
+
+def _read_json_file(path: str) -> Block:
+    from pyarrow import json as pajson
+
+    return pajson.read_json(path)
+
+
+def _expand_paths(paths, suffixes) -> List[str]:
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(
+                os.path.join(p, f) for f in sorted(os.listdir(p))
+                if f.endswith(suffixes))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no files found for {paths}")
+    return files

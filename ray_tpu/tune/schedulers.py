@@ -1,0 +1,59 @@
+"""Trial schedulers: FIFO + Async Successive Halving (ASHA).
+
+Reference: python/ray/tune/schedulers/async_hyperband.py — rungs at
+grace_period * reduction_factor^k; a trial reaching a rung stops unless its
+metric is in the top 1/reduction_factor of results recorded at that rung.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, metrics: Dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 3, time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.rung_results: Dict[int, List[float]] = defaultdict(list)
+
+    def on_result(self, trial_id: str, metrics: Dict) -> str:
+        t = int(metrics.get(self.time_attr, 0))
+        value = metrics.get(self.metric)
+        if value is None:
+            return CONTINUE
+        value = float(value)
+        if self.mode == "min":
+            value = -value
+        if t >= self.max_t:
+            return STOP
+        for rung in self.rungs:
+            if t == rung:
+                results = self.rung_results[rung]
+                results.append(value)
+                if len(results) < self.rf:
+                    return CONTINUE  # not enough data; optimistic continue
+                cutoff_idx = max(0, math.ceil(len(results) / self.rf) - 1)
+                cutoff = sorted(results, reverse=True)[cutoff_idx]
+                return CONTINUE if value >= cutoff else STOP
+        return CONTINUE

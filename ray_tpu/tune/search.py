@@ -1,0 +1,66 @@
+"""Search space primitives + variant generation.
+
+Reference: python/ray/tune/search (basic_variant grid/random sampling).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+@dataclass
+class Sampler:
+    sample: Callable[[random.Random], Any]
+
+
+def grid_search(values: List[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def choice(values: List[Any]) -> Sampler:
+    values = list(values)
+    return Sampler(lambda rng: rng.choice(values))
+
+
+def uniform(low: float, high: float) -> Sampler:
+    return Sampler(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> Sampler:
+    import math
+
+    return Sampler(lambda rng: math.exp(rng.uniform(math.log(low), math.log(high))))
+
+
+def randint(low: int, high: int) -> Sampler:
+    return Sampler(lambda rng: rng.randrange(low, high))
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int,
+                      seed: int = 0) -> List[Dict[str, Any]]:
+    """Cross product over grid_search entries x num_samples draws of samplers."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
+    grids = [param_space[k].values for k in grid_keys]
+    variants: List[Dict[str, Any]] = []
+    combos = list(itertools.product(*grids)) if grid_keys else [()]
+    for _ in range(max(num_samples, 1)):
+        for combo in combos:
+            cfg: Dict[str, Any] = {}
+            for k, v in param_space.items():
+                if isinstance(v, GridSearch):
+                    cfg[k] = combo[grid_keys.index(k)]
+                elif isinstance(v, Sampler):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            variants.append(cfg)
+    return variants

@@ -1,0 +1,180 @@
+"""Tuner: hyperparameter sweeps over trial actors.
+
+Reference: python/ray/tune/tuner.py + execution/tune_controller.py — trials
+run as resource-requesting actors; intermediate ``tune.report`` results flow
+through a report hub actor; the scheduler (e.g. ASHA) stops losers early by
+failing their next report with ``TuneStopException``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import generate_variants
+
+_session = threading.local()
+
+
+class TuneStopException(Exception):
+    """Raised inside a trial when the scheduler stops it early."""
+
+
+@dataclass
+class TuneConfig:
+    metric: str = "score"
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    scheduler: Any = None
+    seed: int = 0
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Dict[str, Any]
+    error: Optional[str] = None
+    stopped_early: bool = False
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: str, mode: str):
+        self.results = results
+        self._metric = metric
+        self._mode = mode
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        ok = [r for r in self.results if r.error is None and metric in r.metrics]
+        if not ok:
+            raise ValueError("no successful trials with the requested metric")
+        sign = 1 if mode == "max" else -1
+        return max(ok, key=lambda r: sign * float(r.metrics[metric]))
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([
+            {**r.metrics, **{f"config/{k}": v for k, v in r.config.items()},
+             "trial_id": r.trial_id, "error": r.error}
+            for r in self.results
+        ])
+
+    def __len__(self):
+        return len(self.results)
+
+
+@ray_tpu.remote(num_cpus=0.1)
+class _ReportHub:
+    """Collects trial reports and runs scheduler decisions centrally."""
+
+    def __init__(self, scheduler_blob: bytes):
+        self.scheduler = cloudpickle.loads(scheduler_blob)
+        self.latest: Dict[str, Dict] = {}
+        self.iters: Dict[str, int] = {}
+
+    def report(self, trial_id: str, metrics: Dict) -> str:
+        self.iters[trial_id] = self.iters.get(trial_id, 0) + 1
+        metrics = dict(metrics)
+        metrics.setdefault("training_iteration", self.iters[trial_id])
+        self.latest[trial_id] = metrics
+        return self.scheduler.on_result(trial_id, metrics)
+
+    def get_latest(self):
+        return dict(self.latest)
+
+
+@ray_tpu.remote
+def _run_trial(fn_blob: bytes, config, trial_id: str, hub) -> Dict:
+    # runtime imports: the decorated function pickles by value, so it must not
+    # close over module globals (the thread-local session is unpicklable)
+    import cloudpickle as _cp
+
+    from ray_tpu.tune import tuner as _tuner
+
+    fn = _cp.loads(fn_blob)
+    _tuner._session.hub = hub
+    _tuner._session.trial_id = trial_id
+    try:
+        out = fn(config)
+        return {"metrics": out if isinstance(out, dict) else {}, "stopped": False}
+    except _tuner.TuneStopException:
+        return {"metrics": {}, "stopped": True}
+    finally:
+        _tuner._session.hub = None
+
+
+def report(metrics: Dict[str, Any], checkpoint=None):
+    """tune.report inside a trial; raises TuneStopException on ASHA stop."""
+    hub = getattr(_session, "hub", None)
+    if hub is None:
+        raise RuntimeError("tune.report called outside a trial")
+    decision = ray_tpu.get(
+        hub.report.remote(_session.trial_id, metrics), timeout=300)
+    if decision == STOP:
+        raise TuneStopException()
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *, param_space: Dict[str, Any],
+                 tune_config: Optional[TuneConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self.trainable = trainable
+        self.param_space = param_space
+        self.tune_config = tune_config or TuneConfig()
+        self.resources = resources_per_trial or {"CPU": 1.0}
+
+    def fit(self) -> ResultGrid:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
+        scheduler = tc.scheduler or FIFOScheduler()
+        hub = _ReportHub.options(
+            name=f"tune_hub_{uuid.uuid4().hex[:8]}", max_concurrency=16,
+        ).remote(cloudpickle.dumps(scheduler))
+        fn_blob = cloudpickle.dumps(self.trainable)
+
+        pending = [(f"trial_{i:05d}", cfg) for i, cfg in enumerate(variants)]
+        running: Dict[Any, tuple] = {}
+        results: List[TrialResult] = []
+        while pending or running:
+            while pending and len(running) < tc.max_concurrent_trials:
+                trial_id, cfg = pending.pop(0)
+                ref = _run_trial.options(
+                    num_cpus=self.resources.get("CPU", 1.0),
+                    num_tpus=self.resources.get("TPU", 0.0),
+                    resources={k: v for k, v in self.resources.items()
+                               if k not in ("CPU", "TPU")},
+                ).remote(fn_blob, cfg, trial_id, hub)
+                running[ref] = (trial_id, cfg)
+            ready, _ = ray_tpu.wait(list(running.keys()), num_returns=1,
+                                    timeout=1.0)
+            for ref in ready:
+                trial_id, cfg = running.pop(ref)
+                latest = ray_tpu.get(hub.get_latest.remote(), timeout=60).get(
+                    trial_id, {})
+                try:
+                    out = ray_tpu.get(ref, timeout=60)
+                    final = dict(latest)
+                    final.update(out.get("metrics") or {})
+                    results.append(TrialResult(trial_id, cfg, final,
+                                               stopped_early=out.get("stopped",
+                                                                     False)))
+                except TaskError as e:
+                    results.append(TrialResult(trial_id, cfg, latest,
+                                               error=str(e)[:500]))
+        ray_tpu.kill(hub)
+        return ResultGrid(results, tc.metric, tc.mode)

@@ -1,0 +1,107 @@
+"""Data layer tests (reference tier: python/ray/data/tests basics)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_range_map_filter_take(cluster):
+    ds = rd.range(100, parallelism=4).map(lambda r: {"id": r["id"] * 2})
+    ds = ds.filter(lambda r: r["id"] % 4 == 0)
+    out = ds.take(5)
+    assert [r["id"] for r in out] == [0, 4, 8, 12, 16]
+    assert ds.count() == 50
+
+
+def test_map_batches(cluster):
+    ds = rd.range(64, parallelism=2).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2}, batch_size=16)
+    rows = ds.take_all()
+    assert rows[5]["sq"] == 25
+    assert len(rows) == 64
+
+
+def test_flat_map_and_union(cluster):
+    a = rd.from_items([{"x": 1}, {"x": 2}], parallelism=1)
+    b = a.flat_map(lambda r: [r, r])
+    assert b.count() == 4
+    assert a.union(b).count() == 6
+
+
+def test_iter_batches_shapes(cluster):
+    ds = rd.range(50, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=16))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 50
+    assert all(s == 16 for s in sizes[:-1])
+
+
+def test_repartition_and_split(cluster):
+    ds = rd.range(40, parallelism=3).repartition(4)
+    assert ds.num_blocks() == 4
+    parts = rd.range(40, parallelism=2).split(4)
+    counts = [p.count() for p in parts]
+    assert sum(counts) == 40
+    assert all(c == 10 for c in counts)
+    ids = sorted(r["id"] for p in parts for r in p.take_all())
+    assert ids == list(range(40))
+
+
+def test_random_shuffle(cluster):
+    ds = rd.range(30, parallelism=2).random_shuffle(seed=42)
+    ids = [r["id"] for r in ds.take_all()]
+    assert sorted(ids) == list(range(30))
+    assert ids != list(range(30))
+
+
+def test_parquet_roundtrip(cluster, tmp_path):
+    ds = rd.range(20, parallelism=2).map(lambda r: {"id": r["id"], "y": r["id"] * 1.5})
+    files = ds.write_parquet(str(tmp_path / "pq"))
+    assert len(files) == 2
+    back = rd.read_parquet(str(tmp_path / "pq"))
+    assert back.count() == 20
+    assert back.to_pandas()["y"].sum() == sum(i * 1.5 for i in range(20))
+
+
+def test_from_pandas_and_numpy(cluster):
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1, 2, 3]})
+    assert rd.from_pandas(df).count() == 3
+    assert rd.from_numpy(np.ones((4, 2))).count() == 4
+
+
+def test_train_integration_shards(cluster, tmp_path):
+    """Dataset splits feed train workers via get_dataset_shard."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    ds = rd.range(20, parallelism=2)
+
+    def loop(config):
+        from ray_tpu import train
+
+        shard = train.get_dataset_shard("train")
+        total = sum(r["id"] for r in shard.take_all())
+        train.report({"total": total, "rank": train.get_context().get_world_rank()})
+        return total
+
+    trainer = JaxTrainer(
+        loop,
+        train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1.0}),
+        run_config=RunConfig(storage_path=str(tmp_path), name="shards"),
+        datasets={"train": ds},
+    )
+    result = trainer.fit()
+    assert result.error is None
