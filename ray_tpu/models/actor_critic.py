@@ -1,0 +1,25 @@
+"""MLP actor-critic for RL (reference capability: rllib RLModule default
+MLP nets, core/rl_module/). Discrete-action policy + value head in flax."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ActorCritic(nn.Module):
+    action_dim: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.tanh(nn.Dense(h, name=f"torso_{i}")(x))
+        logits = nn.Dense(self.action_dim, name="pi",
+                          kernel_init=nn.initializers.orthogonal(0.01))(x)
+        v = nn.Dense(1, name="vf", kernel_init=nn.initializers.orthogonal(1.0))(x)
+        return logits, v[..., 0]

@@ -1,0 +1,9 @@
+"""ray_tpu.rl: reinforcement learning (reference: rllib core loop).
+
+Round 1 ships PPO (env-runner actors + jax learner); the Algorithm/Config
+shape mirrors rllib's AlgorithmConfig.build() -> Algorithm.train().
+"""
+
+from ray_tpu.rl.ppo import PPO, PPOConfig, PPOLearner
+
+__all__ = ["PPO", "PPOConfig", "PPOLearner"]

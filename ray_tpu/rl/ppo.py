@@ -1,0 +1,277 @@
+"""PPO: env-runner actors sampling + a jax learner (GAE, clipped objective).
+
+Reference: rllib — EnvRunnerGroup (env/env_runner_group.py:70) of actors
+stepping gymnasium envs, Learner/LearnerGroup (core/learner/learner.py:112)
+doing the update, Algorithm.train() orchestrating one iteration
+(algorithms/ppo/ppo.py:390 training_step). TPU-first deviations: the learner
+is jax/optax (jit-compiled update over minibatches via lax control flow);
+multi-learner gradient sync is GSPMD/psum inside jit rather than torch DDP
+(torch_learner.py:524-547).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+@dataclass
+class PPOConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_length: int = 128
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip_eps: float = 0.2
+    lr: float = 1e-3
+    entropy_coef: float = 0.005
+    vf_coef: float = 0.5
+    epochs: int = 8
+    num_minibatches: int = 4
+    hidden: tuple = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+@ray_tpu.remote(num_cpus=1)
+class EnvRunner:
+    """Vectorized env sampler (reference: env/single_agent_env_runner.py:68)."""
+
+    def __init__(self, config_blob: bytes, worker_index: int):
+        import cloudpickle as _cp
+
+        import gymnasium as gym
+
+        self.cfg: PPOConfig = _cp.loads(config_blob)
+        fns = [lambda: gym.make(self.cfg.env)
+               for _ in range(self.cfg.num_envs_per_runner)]
+        try:
+            # same-step autoreset: the obs after a done is the next episode's
+            # reset obs, so every stored transition is a real one (gymnasium
+            # >=1.0 defaults to next-step autoreset, which would poison GAE)
+            from gymnasium.vector import AutoresetMode
+
+            self.envs = gym.vector.SyncVectorEnv(
+                fns, autoreset_mode=AutoresetMode.SAME_STEP)
+        except (ImportError, TypeError):
+            self.envs = gym.vector.SyncVectorEnv(fns)
+        self.obs, _ = self.envs.reset(seed=self.cfg.seed + worker_index * 1000)
+        self._apply = None
+        self._rng_seed = self.cfg.seed * 7919 + worker_index
+        self.episode_returns = np.zeros(self.cfg.num_envs_per_runner)
+        self.finished_returns: List[float] = []
+
+    def _policy(self):
+        if self._apply is None:
+            from ray_tpu.utils import import_jax
+
+            jax = import_jax()
+
+            from ray_tpu.models.actor_critic import ActorCritic
+
+            n_act = int(self.envs.single_action_space.n)
+            model = ActorCritic(n_act, self.cfg.hidden)
+            self._apply = jax.jit(
+                lambda params, obs: model.apply({"params": params}, obs))
+        return self._apply
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        """Collect rollout_length steps from each vector env."""
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+
+        apply = self._policy()
+        T, N = self.cfg.rollout_length, self.cfg.num_envs_per_runner
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T + 1, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        key = jax.random.PRNGKey(self._rng_seed)
+        self._rng_seed += 1
+        for t in range(T):
+            logits, value = apply(params, jnp.asarray(self.obs, jnp.float32))
+            key, sub = jax.random.split(key)
+            action = jax.random.categorical(sub, logits)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, action[:, None], axis=-1)[:, 0]
+            action = np.asarray(action)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            self.obs, rew, term, trunc, _ = self.envs.step(action)
+            done = np.logical_or(term, trunc)
+            rew_buf[t] = rew
+            done_buf[t] = done
+            self.episode_returns += rew
+            for i in np.nonzero(done)[0]:
+                self.finished_returns.append(float(self.episode_returns[i]))
+                self.episode_returns[i] = 0.0
+        _, last_value = apply(params, jnp.asarray(self.obs, jnp.float32))
+        val_buf[T] = np.asarray(last_value)
+        # GAE (reference: rllib postprocessing/advantages)
+        adv = np.zeros((T, N), np.float32)
+        lastgae = np.zeros(N, np.float32)
+        for t in reversed(range(T)):
+            nonterminal = 1.0 - done_buf[t]
+            delta = (rew_buf[t] + self.cfg.gamma * val_buf[t + 1] * nonterminal
+                     - val_buf[t])
+            lastgae = delta + self.cfg.gamma * self.cfg.gae_lambda * nonterminal * lastgae
+            adv[t] = lastgae
+        returns = adv + val_buf[:T]
+        ep_returns, self.finished_returns = self.finished_returns, []
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
+        return {
+            "obs": flat(obs_buf),
+            "actions": flat(act_buf),
+            "logp": flat(logp_buf),
+            "advantages": flat(adv),
+            "returns": flat(returns),
+            "episode_returns": np.asarray(ep_returns, np.float32),
+        }
+
+
+class PPOLearner:
+    """jit-compiled PPO update (single process; LearnerGroup shards batches
+    over a mesh via psum in later rounds)."""
+
+    def __init__(self, cfg: PPOConfig, obs_dim: int, n_actions: int):
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.actor_critic import ActorCritic
+
+        self.cfg = cfg
+        self.model = ActorCritic(n_actions, cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = self.model.init(key, jnp.zeros((1, obs_dim)))["params"]
+        self.opt = optax.chain(optax.clip_by_global_norm(0.5), optax.adam(cfg.lr))
+        self.opt_state = self.opt.init(self.params)
+        self._jax = jax
+
+        def loss_fn(params, batch):
+            logits, values = self.model.apply({"params": params}, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pg1 = ratio * adv
+            pg2 = jnp.clip(ratio, 1 - cfg.clip_eps, 1 + cfg.clip_eps) * adv
+            pg_loss = -jnp.minimum(pg1, pg2).mean()
+            vf_loss = ((values - batch["returns"]) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg_loss + cfg.vf_coef * vf_loss - cfg.entropy_coef * entropy
+            return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update_minibatch(carry, batch):
+            params, opt_state = carry
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            import optax as _optax
+
+            params = _optax.apply_updates(params, updates)
+            return (params, opt_state), {"loss": loss, **aux}
+
+        self._update_minibatch = jax.jit(update_minibatch)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import numpy as _np
+
+        cfg = self.cfg
+        n = len(batch["obs"])
+        idx = _np.arange(n)
+        rng = _np.random.default_rng(cfg.seed)
+        metrics = {}
+        mb = max(1, n // cfg.num_minibatches)
+        for _ in range(cfg.epochs):
+            rng.shuffle(idx)
+            for start in range(0, n, mb):
+                sel = idx[start:start + mb]
+                minibatch = {k: v[sel] for k, v in batch.items()
+                             if k != "episode_returns"}
+                (self.params, self.opt_state), metrics = self._update_minibatch(
+                    (self.params, self.opt_state), minibatch)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_params(self):
+        return self.params
+
+
+class PPO:
+    """Algorithm driver (reference: Algorithm.step at algorithm.py:1189)."""
+
+    def __init__(self, cfg: PPOConfig):
+        import cloudpickle
+
+        import gymnasium as gym
+
+        self.cfg = cfg
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        probe = gym.make(cfg.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+        self.learner = PPOLearner(cfg, obs_dim, n_actions)
+        blob = cloudpickle.dumps(cfg)
+        self.runners = [EnvRunner.remote(blob, i)
+                        for i in range(cfg.num_env_runners)]
+        self.iteration = 0
+        self._return_window: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: parallel sampling -> PPO update -> weight sync."""
+        t0 = time.time()
+        params = self.learner.get_params()
+        params_np = self._jax_to_np(params)
+        sample_refs = [r.sample.remote(params_np) for r in self.runners]
+        rollouts = ray_tpu.get(sample_refs, timeout=600)
+        batch = {
+            k: np.concatenate([r[k] for r in rollouts])
+            for k in rollouts[0]
+        }
+        metrics = self.learner.update(batch)
+        self.iteration += 1
+        self._return_window.extend(batch["episode_returns"].tolist())
+        self._return_window = self._return_window[-100:]
+        steps = len(batch["obs"])
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (float(np.mean(self._return_window))
+                                    if self._return_window else 0.0),
+            "num_env_steps_sampled": steps,
+            "steps_per_sec": steps / max(time.time() - t0, 1e-6),
+            **metrics,
+        }
+
+    @staticmethod
+    def _jax_to_np(tree):
+        import jax
+
+        return jax.tree.map(lambda x: np.asarray(x), tree)
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

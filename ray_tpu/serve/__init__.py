@@ -1,0 +1,37 @@
+"""ray_tpu.serve: model serving (reference: ray.serve).
+
+Shape of the reference (SURVEY.md §3.5): ``serve.run`` -> ``ServeController``
+actor reconciling deployment replica sets (_private/deployment_state.py);
+requests enter through a ``DeploymentHandle`` whose router picks a replica by
+power-of-two-choices on queue length (request_router/pow_2_router.py:27);
+an HTTP proxy actor (aiohttp) fronts handles; ``@serve.batch`` provides
+dynamic batching inside replicas (serve/batching.py).
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    DeploymentHandle,
+    batch,
+    delete,
+    deployment,
+    get_app_handle,
+    run,
+    shutdown,
+    start_http_proxy,
+    status,
+)
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "DeploymentHandle",
+    "run",
+    "delete",
+    "status",
+    "shutdown",
+    "get_app_handle",
+    "batch",
+    "start_http_proxy",
+]

@@ -1,0 +1,466 @@
+"""Serve implementation: deployments, controller, replicas, router, batching.
+
+Reference mapping:
+- ``@serve.deployment`` / ``.bind`` / ``serve.run``: serve/api.py:320,681
+- ``ServeController``: serve/_private/controller.py:102 (reconciles replica
+  sets, restarts dead replicas)
+- replica: serve/_private/replica.py (user callable behind an actor)
+- router: power-of-two-choices on outstanding requests
+  (serve/_private/request_router/pow_2_router.py:27), client-side here
+- ``@serve.batch``: serve/batching.py (async dynamic batching)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import functools
+import random
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+
+CONTROLLER_NAME = "serve_controller"
+
+
+# ---------------------------------------------------------------------------
+# public authoring API
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    ray_actor_options: Dict[str, Any] = field(default_factory=lambda: {"num_cpus": 1.0})
+    health_check_period_s: float = 2.0
+
+
+class Deployment:
+    def __init__(self, target, name: str, config: DeploymentConfig):
+        self._target = target
+        self.name = name
+        self.config = config
+
+    def options(self, *, name: Optional[str] = None, num_replicas: Optional[int] = None,
+                max_ongoing_requests: Optional[int] = None,
+                ray_actor_options: Optional[Dict[str, Any]] = None) -> "Deployment":
+        cfg = copy.deepcopy(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        return Deployment(self._target, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+    init_args: tuple
+    init_kwargs: dict
+
+
+def deployment(target=None, *, name: Optional[str] = None, num_replicas: int = 1,
+               max_ongoing_requests: int = 16,
+               ray_actor_options: Optional[Dict[str, Any]] = None):
+    """@serve.deployment on a class or function."""
+
+    def wrap(t):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=ray_actor_options or {"num_cpus": 1.0},
+        )
+        return Deployment(t, name or t.__name__, cfg)
+
+    if target is not None:
+        return wrap(target)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# replica actor
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class _Replica:
+    def __init__(self, target_blob: bytes, init_args_blob: bytes):
+        import cloudpickle as _cp
+
+        target = _cp.loads(target_blob)
+        args, kwargs = _cp.loads(init_args_blob)
+        # resolve nested Applications into handles (model composition)
+        args = tuple(_resolve_app_args(a) for a in args)
+        kwargs = {k: _resolve_app_args(v) for k, v in kwargs.items()}
+        if isinstance(target, type):
+            self._callable = target(*args, **kwargs)
+        else:
+            self._callable = functools.partial(target, *args, **kwargs) \
+                if args or kwargs else target
+        self._num_ongoing = 0
+
+    async def handle_request(self, method_name: str, args_blob: bytes):
+        import cloudpickle as _cp
+
+        args, kwargs = _cp.loads(args_blob)
+        self._num_ongoing += 1
+        try:
+            if method_name == "__call__":
+                if not callable(self._callable):
+                    raise TypeError("deployment target is not callable")
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name)
+            if asyncio.iscoroutinefunction(fn):
+                out = await fn(*args, **kwargs)
+            else:
+                # sync user code runs off-loop so it can call other handles
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(
+                    None, functools.partial(fn, *args, **kwargs))
+                if asyncio.iscoroutine(out):
+                    out = await out
+            return out
+        finally:
+            self._num_ongoing -= 1
+
+    def num_ongoing(self) -> int:
+        return self._num_ongoing
+
+    def health(self) -> bool:
+        return True
+
+
+def _resolve_app_args(v):
+    if isinstance(v, Application):
+        return get_app_handle(v.deployment.name)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# controller actor
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class _ServeController:
+    """Reconciles target replica sets; restarts dead replicas."""
+
+    def __init__(self):
+        self.apps: Dict[str, dict] = {}  # name -> {blob, init, cfg, replicas}
+        self._running = True
+
+    def deploy(self, name: str, target_blob: bytes, init_blob: bytes,
+               cfg_blob: bytes) -> bool:
+        import cloudpickle as _cp
+
+        cfg = _cp.loads(cfg_blob)
+        old = self.apps.get(name)
+        if old:
+            for r in old["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        self.apps[name] = {"blob": target_blob, "init": init_blob, "cfg": cfg,
+                           "replicas": []}
+        self._reconcile(name)
+        return True
+
+    def _reconcile(self, name: str):
+        from ray_tpu.serve import api as _api
+
+        app = self.apps[name]
+        cfg = app["cfg"]
+        want = cfg.num_replicas
+        alive = []
+        for r in app["replicas"]:
+            try:
+                ray_tpu.get(r.health.remote(), timeout=10)
+                alive.append(r)
+            except Exception:
+                pass
+        while len(alive) < want:
+            opts = dict(cfg.ray_actor_options)
+            replica = _api._Replica.options(
+                num_cpus=opts.get("num_cpus", 1.0),
+                num_tpus=opts.get("num_tpus", 0.0),
+                resources=opts.get("resources", {}),
+                max_concurrency=cfg.max_ongoing_requests,
+                max_restarts=-1,
+            ).remote(app["blob"], app["init"])
+            alive.append(replica)
+        for extra in alive[want:]:
+            try:
+                ray_tpu.kill(extra)
+            except Exception:
+                pass
+        app["replicas"] = alive[:want]
+
+    def check_replicas(self):
+        """Periodic health reconcile (driven by handle/proxy pings)."""
+        for name in list(self.apps):
+            self._reconcile(name)
+        return True
+
+    def get_replicas(self, name: str):
+        app = self.apps.get(name)
+        if app is None:
+            raise KeyError(f"no deployment named {name!r}")
+        return list(app["replicas"])
+
+    def delete(self, name: str) -> bool:
+        app = self.apps.pop(name, None)
+        if app:
+            for r in app["replicas"]:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        return True
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            name: {"num_replicas": len(app["replicas"]),
+                   "target": app["cfg"].num_replicas}
+            for name, app in self.apps.items()
+        }
+
+
+def _get_controller(create: bool = True):
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        if not create:
+            raise
+        return _ServeController.options(
+            name=CONTROLLER_NAME, lifetime="detached", num_cpus=0.1,
+            max_concurrency=16, get_if_exists=True).remote()
+
+
+# ---------------------------------------------------------------------------
+# handle + router
+# ---------------------------------------------------------------------------
+
+
+class DeploymentHandle:
+    """Client-side router: power-of-two-choices over replica pending counts."""
+
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self._name = deployment_name
+        self._method = method_name
+        self._replicas: List[Any] = []
+        self._pending: Dict[Any, int] = {}
+        self._last_refresh = 0.0
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self._name, method_name)
+        h._replicas = self._replicas
+        h._pending = self._pending
+        return h
+
+    def _refresh(self, force: bool = False):
+        if not force and self._replicas and time.monotonic() - self._last_refresh < 5.0:
+            return
+        controller = _get_controller(create=False)
+        self._replicas = ray_tpu.get(
+            controller.get_replicas.remote(self._name), timeout=60)
+        self._pending = {r: 0 for r in self._replicas}
+        self._last_refresh = time.monotonic()
+
+    def _pick(self):
+        self._refresh()
+        if not self._replicas:
+            raise RuntimeError(f"deployment {self._name} has no replicas")
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        return a if self._pending.get(a, 0) <= self._pending.get(b, 0) else b
+
+    def remote(self, *args, **kwargs):
+        replica = self._pick()
+        # pending counters decay by zeroing at each periodic refresh
+        self._pending[replica] = self._pending.get(replica, 0) + 1
+        blob = cloudpickle.dumps((args, kwargs))
+        return replica.handle_request.remote(self._method, blob)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._name, self._method))
+
+
+def get_app_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+# ---------------------------------------------------------------------------
+# run / delete / status
+# ---------------------------------------------------------------------------
+
+
+def run(app: Application, name: Optional[str] = None, *,
+        _blocking: bool = True) -> DeploymentHandle:
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    controller = _get_controller()
+    dep = app.deployment
+    deploy_name = name or dep.name
+    ray_tpu.get(controller.deploy.remote(
+        deploy_name,
+        cloudpickle.dumps(dep._target),
+        cloudpickle.dumps((app.init_args, app.init_kwargs)),
+        cloudpickle.dumps(dep.config)), timeout=600)
+    handle = DeploymentHandle(deploy_name)
+    handle._refresh(force=True)
+    return handle
+
+
+def delete(name: str):
+    controller = _get_controller(create=False)
+    ray_tpu.get(controller.delete.remote(name), timeout=60)
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_controller(create=False)
+    return ray_tpu.get(controller.status.remote(), timeout=60)
+
+
+def shutdown():
+    try:
+        controller = _get_controller(create=False)
+    except ValueError:
+        return
+    for name in list(ray_tpu.get(controller.status.remote(), timeout=60)):
+        ray_tpu.get(controller.delete.remote(name), timeout=60)
+    ray_tpu.kill(controller)
+
+
+# ---------------------------------------------------------------------------
+# dynamic batching (reference: serve/batching.py)
+# ---------------------------------------------------------------------------
+
+
+def batch(_fn=None, *, max_batch_size: int = 8, batch_wait_timeout_s: float = 0.01):
+    """Decorator for async methods taking a list of requests: concurrent
+    single calls are buffered into one batched invocation."""
+
+    def wrap(fn):
+        state = {"queue": [], "event": None, "task": None}
+
+        async def flush(self_ref):
+            await asyncio.sleep(batch_wait_timeout_s)
+            await do_flush(self_ref)
+
+        async def do_flush(self_ref):
+            queue, state["queue"] = state["queue"], []
+            state["task"] = None
+            if not queue:
+                return
+            items = [item for item, _ in queue]
+            futs = [f for _, f in queue]
+            try:
+                results = await fn(self_ref, items) if self_ref is not None \
+                    else await fn(items)
+                for f, r in zip(futs, results):
+                    if not f.done():
+                        f.set_result(r)
+            except Exception as e:
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(e)
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            if len(args) == 2:
+                self_ref, item = args
+            else:
+                self_ref, item = None, args[0]
+            fut = asyncio.get_event_loop().create_future()
+            state["queue"].append((item, fut))
+            if len(state["queue"]) >= max_batch_size:
+                if state["task"] is not None:
+                    state["task"].cancel()
+                    state["task"] = None
+                await do_flush(self_ref)
+            elif state["task"] is None:
+                state["task"] = asyncio.ensure_future(flush(self_ref))
+            return await fut
+
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# HTTP proxy (reference: serve/_private/proxy.py)
+# ---------------------------------------------------------------------------
+
+
+@ray_tpu.remote
+class _HttpProxy:
+    """aiohttp ingress: POST /<deployment> with a JSON body routes to the
+    deployment handle and returns the JSON-serialized response."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self._runner = None
+
+    async def start(self) -> int:
+        import json
+
+        from aiohttp import web
+
+        def _route(name, body):
+            h = DeploymentHandle(name)
+            return ray_tpu.get(h.remote(body), timeout=120)
+
+        async def handle(request):
+            name = request.match_info["name"]
+            try:
+                body = await request.json() if request.can_read_body else {}
+            except Exception:
+                body = {}
+            try:
+                # route off-loop: handle calls block on the core worker
+                loop = asyncio.get_event_loop()
+                result = await loop.run_in_executor(
+                    None, functools.partial(_route, name, body))
+                return web.json_response({"result": result})
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=500)
+
+        app = web.Application()
+        app.router.add_post("/{name}", handle)
+        app.router.add_get("/-/healthz", lambda r: web.json_response({"ok": True}))
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", self.port)
+        await site.start()
+        return self.port
+
+
+def start_http_proxy(port: int = 0) -> int:
+    import socket
+
+    if port == 0:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+    proxy = _HttpProxy.options(name="serve_http_proxy", lifetime="detached",
+                               num_cpus=0.1, max_concurrency=64,
+                               get_if_exists=True).remote(port)
+    return ray_tpu.get(proxy.start.remote(), timeout=120)

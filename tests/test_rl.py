@@ -1,0 +1,36 @@
+"""RL smoke tests: PPO on CartPole improves (reference tier: rllib
+tuned_examples run-to-reward, shrunk for CI)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import PPO, PPOConfig
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_ppo_cartpole_improves(cluster):
+    algo = PPOConfig(
+        env="CartPole-v1",
+        num_env_runners=2,
+        num_envs_per_runner=4,
+        rollout_length=128,
+        epochs=8,
+        seed=1,
+    ).build()
+    first = algo.train()
+    assert first["num_env_steps_sampled"] == 2 * 4 * 128
+    returns = []
+    for _ in range(20):
+        m = algo.train()
+        returns.append(m["episode_return_mean"])
+    algo.stop()
+    # CartPole random play ~ 20; PPO must clearly improve within ~20k steps
+    assert max(returns) > 60, returns

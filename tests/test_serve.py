@@ -1,0 +1,146 @@
+"""Serve tests (reference tier: python/ray/serve/tests basics)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=6)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+def test_function_deployment(cluster):
+    @serve.deployment
+    def doubler(body):
+        return body["x"] * 2
+
+    handle = serve.run(doubler.bind())
+    assert ray_tpu.get(handle.remote({"x": 21}), timeout=120) == 42
+    serve.delete("doubler")
+
+
+def test_class_deployment_replicas_and_status(cluster):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def __call__(self, body):
+            self.n += 1
+            return {"pid_count": self.n, "base": self.n}
+
+        def peek(self, body=None):
+            return self.n
+
+    handle = serve.run(Counter.bind(10))
+    outs = ray_tpu.get([handle.remote({}) for _ in range(6)], timeout=120)
+    assert all(o["base"] >= 11 for o in outs)
+    st = serve.status()
+    assert st["Counter"]["num_replicas"] == 2
+    # method routing
+    peek = handle.options(method_name="peek")
+    assert ray_tpu.get(peek.remote(), timeout=60) >= 10
+    serve.delete("Counter")
+
+
+def test_model_composition(cluster):
+    @serve.deployment
+    class Child:
+        def __call__(self, body):
+            return body["v"] + 1
+
+    @serve.deployment
+    class Parent:
+        def __init__(self, child):
+            self.child = child
+
+        def __call__(self, body):
+            inner = ray_tpu.get(self.child.remote({"v": body["v"]}))
+            return inner * 10
+
+    child_app = Child.bind()
+    serve.run(child_app)
+    handle = serve.run(Parent.bind(child_app))
+    assert ray_tpu.get(handle.remote({"v": 4}), timeout=120) == 50
+    serve.delete("Parent")
+    serve.delete("Child")
+
+
+def test_batching(cluster):
+    @serve.deployment
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+        async def __call__(self, bodies):
+            # one invocation sees multiple queued requests
+            n = len(bodies)
+            return [{"batch_size": n, "x": b["x"]} for b in bodies]
+
+    handle = serve.run(Batched.bind())
+    refs = [handle.remote({"x": i}) for i in range(4)]
+    outs = ray_tpu.get(refs, timeout=120)
+    assert {o["x"] for o in outs} == {0, 1, 2, 3}
+    assert max(o["batch_size"] for o in outs) >= 2
+    serve.delete("Batched")
+
+
+def test_replica_restart_on_death(cluster):
+    import os
+
+    @serve.deployment
+    class Fragile:
+        def __call__(self, body):
+            if body.get("die"):
+                os._exit(1)
+            return "alive"
+
+    handle = serve.run(Fragile.bind())
+    assert ray_tpu.get(handle.remote({}), timeout=120) == "alive"
+    try:
+        ray_tpu.get(handle.remote({"die": True}), timeout=60)
+    except Exception:
+        pass
+    # controller reconciles on demand
+    controller = ray_tpu.get_actor("serve_controller")
+    deadline = time.time() + 60
+    ok = False
+    while time.time() < deadline:
+        ray_tpu.get(controller.check_replicas.remote(), timeout=60)
+        handle._refresh(force=True)
+        try:
+            if ray_tpu.get(handle.remote({}), timeout=30) == "alive":
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert ok
+    serve.delete("Fragile")
+
+
+def test_http_proxy(cluster):
+    import json
+    import urllib.request
+
+    @serve.deployment
+    def echo(body):
+        return {"echo": body}
+
+    serve.run(echo.bind())
+    port = serve.start_http_proxy()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo", data=json.dumps({"hi": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        out = json.loads(resp.read())
+    assert out["result"]["echo"] == {"hi": 1}
+    serve.delete("echo")
