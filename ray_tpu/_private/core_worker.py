@@ -395,11 +395,18 @@ class CoreWorker:
             return
         if reply["status"] != "ok":
             raise ObjectLostError(f"object store rejected {oid.hex()}: {reply}")
-        seg = ShmSegment(reply["shm_name"])
-        try:
-            write_blob(seg.buf, inband, buffers, offsets)
-        finally:
-            seg.close()
+        if "arena_name" in reply:
+            # native arena backend: write into the shared arena at the offset
+            seg = self.segments.open(reply["arena_name"])
+            off = reply["offset"]
+            region = memoryview(seg.buf)[off : off + total]
+            write_blob(region, inband, buffers, offsets)
+        else:
+            seg = ShmSegment(reply["shm_name"])
+            try:
+                write_blob(seg.buf, inband, buffers, offsets)
+            finally:
+                seg.close()
         await self.raylet.call("StoreSeal", pickle.dumps({"oid": oid.binary()}))
 
     async def _read_local_store(self, oid: ObjectID, timeout: float, pull=True):
@@ -413,6 +420,12 @@ class CoreWorker:
         if status == "shm":
             seg = self.segments.open(reply["shm_name"])
             inband, buffers = read_blob(seg.buf)
+            return True, deserialize(inband, buffers)
+        if status == "shm_arena":
+            seg = self.segments.open(reply["arena_name"])
+            off, size = reply["offset"], reply["size"]
+            region = memoryview(seg.buf)[off : off + size]
+            inband, buffers = read_blob(region)
             return True, deserialize(inband, buffers)
         return False, None
 

@@ -122,6 +122,7 @@ def pack_blob(inband: bytes, buffers: List[memoryview]) -> bytes:
 class _Entry:
     __slots__ = (
         "state", "shm", "shm_name", "size", "last_access", "spill_path", "inline",
+        "arena_offset",
     )
 
     def __init__(self):
@@ -132,10 +133,15 @@ class _Entry:
         self.last_access = time.monotonic()
         self.spill_path = ""
         self.inline: Optional[bytes] = None
+        self.arena_offset: Optional[int] = None  # set when backed by the arena
 
 
 class ObjectStoreServer:
-    """Node-local store: create/seal/get with LRU spill-to-disk eviction."""
+    """Node-local store: create/seal/get with LRU spill-to-disk eviction.
+
+    Allocation backends: the native C++ arena (src/object_store/store.cc,
+    first-fit + coalescing over one mmap'd /dev/shm file — the plasma-
+    allocator equivalent) when built, else one /dev/shm file per object."""
 
     def __init__(self, node_hex: str, capacity: Optional[int] = None,
                  spill_dir: Optional[str] = None):
@@ -148,35 +154,68 @@ class ObjectStoreServer:
         self.waiters: Dict[bytes, List[asyncio.Future]] = {}
         self.num_spilled = 0
         self.num_restored = 0
+        self.arena = None
+        self.arena_name = f"rtpu_arena_{node_hex[:8]}"
+        self._arena_view: Optional[ShmSegment] = None
+        backend = RAY_CONFIG.object_store_backend
+        if backend in ("auto", "cpp"):
+            try:
+                from ray_tpu._private.cpp_store import CppArena
+
+                self.arena = CppArena(self.arena_name, self.capacity)
+                self._arena_view = ShmSegment(self.arena_name)
+            except Exception:
+                if backend == "cpp":
+                    raise
+                self.arena = None
 
     def _shm_name(self, oid: bytes) -> str:
         return f"rtpu_{self.node_hex[:8]}_{oid.hex()}"
+
+    def _region(self, e: _Entry):
+        """Server-side view of an entry's bytes (arena slice or shm file)."""
+        if e.arena_offset is not None:
+            view = memoryview(self._arena_view.buf)
+            return view[e.arena_offset : e.arena_offset + e.size]
+        return memoryview(e.shm.buf)[: e.size]
 
     def _evict_for(self, need: int) -> bool:
         """Spill least-recently-used sealed objects until `need` bytes fit."""
         if need > self.capacity:
             return False
+        def fits() -> bool:
+            if self.arena is not None:
+                return self.arena.largest_free() >= need + 64
+            return self.used + need <= self.capacity
+
+        if fits():
+            return True
         candidates = sorted(
             (e.last_access, oid)
             for oid, e in self.objects.items()
-            if e.state == "SEALED" and e.shm is not None
+            if e.state == "SEALED"
+            and (e.shm is not None or e.arena_offset is not None)
         )
         for _, oid in candidates:
-            if self.used + need <= self.capacity:
-                break
             self._spill(oid)
-        return self.used + need <= self.capacity
+            if fits():
+                return True
+        return fits()
 
     def _spill(self, oid: bytes):
         e = self.objects[oid]
         path = os.path.join(self.spill_dir, oid.hex())
         with open(path, "wb") as f:
-            f.write(e.shm.buf)
+            f.write(self._region(e))
         e.spill_path = path
         e.state = "SPILLED"
-        e.shm.close()
-        e.shm.unlink()
-        e.shm = None
+        if e.arena_offset is not None:
+            self.arena.free(oid)
+            e.arena_offset = None
+        elif e.shm is not None:
+            e.shm.close()
+            e.shm.unlink()
+            e.shm = None
         self.used -= e.size
         self.num_spilled += 1
 
@@ -184,11 +223,21 @@ class ObjectStoreServer:
         e = self.objects[oid]
         if not self._evict_for(e.size):
             return False
-        shm = ShmSegment(self._shm_name(oid), e.size, create=True)
         with open(e.spill_path, "rb") as f:
-            shm.buf[:] = f.read()
+            data = f.read()
+        if self.arena is not None:
+            off = self.arena.alloc(oid, e.size)
+            if off is None or off == -2:
+                return False
+            memoryview(self._arena_view.buf)[off : off + e.size] = data
+            self.arena.seal(oid)
+            e.arena_offset = off
+        else:
+            shm = ShmSegment(self._shm_name(oid), e.size, create=True)
+            shm.buf[:] = data
+            e.shm, e.shm_name = shm, shm.name
         os.unlink(e.spill_path)
-        e.shm, e.shm_name, e.spill_path = shm, shm.name, ""
+        e.spill_path = ""
         e.state = "SEALED"
         self.used += e.size
         self.num_restored += 1
@@ -204,6 +253,15 @@ class ObjectStoreServer:
             return {"status": "oom", "capacity": self.capacity}
         e = _Entry()
         e.size = size
+        if self.arena is not None:
+            off = self.arena.alloc(oid, size)
+            if off is None:
+                return {"status": "oom", "capacity": self.capacity}
+            e.arena_offset = off
+            self.objects[oid] = e
+            self.used += size
+            return {"status": "ok", "arena_name": self.arena_name,
+                    "offset": off, "size": size}
         e.shm = ShmSegment(self._shm_name(oid), size, create=True)
         e.shm_name = e.shm.name
         self.objects[oid] = e
@@ -258,6 +316,9 @@ class ObjectStoreServer:
             return {"status": "inline", "blob": e.inline}
         if e.state == "SPILLED" and not self._restore(oid):
             return {"status": "oom"}
+        if e.arena_offset is not None:
+            return {"status": "shm_arena", "arena_name": self.arena_name,
+                    "offset": e.arena_offset, "size": e.size}
         return {"status": "shm", "shm_name": e.shm_name, "size": e.size}
 
     def read_chunk(self, oid: bytes, offset: int, length: int) -> Optional[bytes]:
@@ -272,7 +333,7 @@ class ObjectStoreServer:
             with open(e.spill_path, "rb") as f:
                 f.seek(offset)
                 return f.read(length)
-        return bytes(e.shm.buf[offset : offset + length])
+        return bytes(self._region(e)[offset : offset + length])
 
     def object_size(self, oid: bytes) -> Optional[int]:
         e = self.objects.get(oid)
@@ -281,9 +342,9 @@ class ObjectStoreServer:
     def write_chunk(self, oid: bytes, offset: int, data: bytes):
         """Pull-side write (store-mediated; remote data lands directly in shm)."""
         e = self.objects.get(oid)
-        if e is None or e.shm is None:
+        if e is None or (e.shm is None and e.arena_offset is None):
             raise KeyError(f"write_chunk on missing object {oid.hex()}")
-        e.shm.buf[offset : offset + len(data)] = data
+        self._region(e)[offset : offset + len(data)] = data
 
     def delete(self, oids: List[bytes]):
         for oid in oids:
@@ -293,7 +354,10 @@ class ObjectStoreServer:
             for fut in self.waiters.pop(oid, []):
                 if not fut.done():
                     fut.cancel()
-            if e.shm is not None:
+            if e.arena_offset is not None:
+                self.used -= e.size
+                self.arena.free(oid)
+            elif e.shm is not None:
                 self.used -= e.size
                 e.shm.close()
                 e.shm.unlink()
@@ -310,10 +374,16 @@ class ObjectStoreServer:
             "num_objects": len(self.objects),
             "num_spilled": self.num_spilled,
             "num_restored": self.num_restored,
+            "backend": "cpp_arena" if self.arena is not None else "shm_files",
         }
 
     def shutdown(self):
         self.delete(list(self.objects.keys()))
+        if self._arena_view is not None:
+            self._arena_view.close()
+        if self.arena is not None:
+            self.arena.close()
+            self.arena = None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,193 @@
+"""DAG authoring + compiled execution (reference: python/ray/dag).
+
+``fn.bind(...)`` / ``Actor.bind(...)`` / ``handle.method.bind(...)`` build a
+lazy graph (dag_node.py, class_node.py, input_node.py); ``execute`` walks it;
+``experimental_compile`` (dag_node.py:279) returns a ``CompiledDAG`` with a
+precomputed topological schedule.
+
+Round-1 scope note: the compiled path pre-resolves the schedule and reuses
+pickled task payloads, but still rides the normal actor-call RPC plane; the
+shared-memory mutable-object channel data plane (reference:
+experimental/channel/shared_memory_channel.py + the seqlock C++ side) is the
+next tier of this module (see channels.py for the channel primitives).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.object_ref import ObjectRef
+
+
+class DAGNode:
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _deps(self) -> List["DAGNode"]:
+        out = []
+        for v in list(self._bound_args) + list(self._bound_kwargs.values()):
+            if isinstance(v, DAGNode):
+                out.append(v)
+        return out
+
+    def execute(self, *input_args, **input_kwargs):
+        """Eagerly execute the graph rooted here; returns an ObjectRef."""
+        cache: Dict[int, Any] = {}
+        return self._execute_node(input_args, input_kwargs, cache)
+
+    def _resolve(self, v, input_args, input_kwargs, cache):
+        if isinstance(v, DAGNode):
+            return v._execute_node(input_args, input_kwargs, cache)
+        return v
+
+    def _resolved_args(self, input_args, input_kwargs, cache):
+        args = [self._resolve(a, input_args, input_kwargs, cache)
+                for a in self._bound_args]
+        kwargs = {k: self._resolve(v, input_args, input_kwargs, cache)
+                  for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_node(self, input_args, input_kwargs, cache):
+        raise NotImplementedError
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to execute() (reference:
+    dag/input_node.py). Supports `with InputNode() as inp:` authoring."""
+
+    def __init__(self, index: int = 0):
+        super().__init__((), {})
+        self._index = index
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_node(self, input_args, input_kwargs, cache):
+        return input_args[self._index]
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_node(self, input_args, input_kwargs, cache):
+        key = id(self)
+        if key not in cache:
+            args, kwargs = self._resolved_args(input_args, input_kwargs, cache)
+            cache[key] = self._remote_fn.remote(*args, **kwargs)
+        return cache[key]
+
+
+class ClassNode(DAGNode):
+    """Actor construction in a DAG; instantiated once per compiled graph."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+        self._actor_handle = None
+
+    def _get_actor(self, input_args, input_kwargs, cache):
+        if self._actor_handle is None:
+            args, kwargs = self._resolved_args(input_args, input_kwargs, cache)
+            args = [ray_tpu.get(a) if isinstance(a, ObjectRef) else a for a in args]
+            self._actor_handle = self._actor_cls.remote(*args, **kwargs)
+        return self._actor_handle
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClassMethodBinder(self, name)
+
+    def _execute_node(self, input_args, input_kwargs, cache):
+        return self._get_actor(input_args, input_kwargs, cache)
+
+
+class _ClassMethodBinder:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method_name, args, kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor_or_node, method_name, args, kwargs):
+        super().__init__(args, kwargs)
+        self._target = actor_or_node
+        self._method_name = method_name
+
+    def _execute_node(self, input_args, input_kwargs, cache):
+        key = id(self)
+        if key not in cache:
+            if isinstance(self._target, ClassNode):
+                handle = self._target._get_actor(input_args, input_kwargs, cache)
+            else:
+                handle = self._target
+            args, kwargs = self._resolved_args(input_args, input_kwargs, cache)
+            method = getattr(handle, self._method_name)
+            cache[key] = method.remote(*args, **kwargs)
+        return cache[key]
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+
+    def _execute_node(self, input_args, input_kwargs, cache):
+        return [self._resolve(o, input_args, input_kwargs, cache)
+                for o in self._bound_args]
+
+
+class CompiledDAG:
+    """Precompiled schedule: topological order fixed once, actors created
+    eagerly (reference: compiled_dag_node.py:805; execute :2546)."""
+
+    def __init__(self, root: DAGNode):
+        self._root = root
+        self._order = self._toposort(root)
+        # instantiate all actors up front
+        for node in self._order:
+            if isinstance(node, ClassNode):
+                node._get_actor((), {}, {})
+
+    @staticmethod
+    def _toposort(root) -> List[DAGNode]:
+        seen: List[DAGNode] = []
+        visiting = set()
+
+        def visit(n: DAGNode):
+            if id(n) in visiting:
+                raise ValueError("cycle in DAG")
+            if n in seen:
+                return
+            visiting.add(id(n))
+            for d in n._deps():
+                visit(d)
+            visiting.discard(id(n))
+            seen.append(n)
+
+        visit(root)
+        return seen
+
+    def execute(self, *args, **kwargs):
+        cache: Dict[int, Any] = {}
+        return self._root._execute_node(args, kwargs, cache)
+
+    def teardown(self):
+        for node in self._order:
+            if isinstance(node, ClassNode) and node._actor_handle is not None:
+                try:
+                    ray_tpu.kill(node._actor_handle)
+                except Exception:
+                    pass
+                node._actor_handle = None

@@ -1,0 +1,96 @@
+"""Channels: mutable shared-memory slots for compiled-graph data flow.
+
+Reference: python/ray/experimental/channel/shared_memory_channel.py backed by
+C++ mutable objects (core_worker/experimental_mutable_object_manager.cc —
+versioned buffers with writer/reader synchronization). TPU-native round-1
+design: a fixed-capacity /dev/shm ring slot with a seqlock header
+
+  [u64 version][u64 payload_len][payload bytes...]
+
+Writers bump version to odd while writing, even when done; readers spin
+until they observe a new even version and a consistent snapshot. One writer,
+N readers, single machine (cross-node channels ride the object plane).
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from typing import Any, Optional
+
+from ray_tpu._private.object_store import ShmSegment
+from ray_tpu._private.serialization import dumps_oob, loads_oob
+
+_HEADER = 16
+
+
+class Channel:
+    """Single-writer multi-reader mutable slot."""
+
+    def __init__(self, name: str, capacity: int = 1 << 20, create: bool = False):
+        self.name = f"rtpu_chan_{name}"
+        self.capacity = capacity
+        if create:
+            self.seg = ShmSegment(self.name, capacity + _HEADER, create=True)
+            struct.pack_into("<QQ", self.seg.buf, 0, 0, 0)
+        else:
+            self.seg = ShmSegment(self.name)
+        self._last_read_version = 0
+
+    # -- writer --
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        blob = dumps_oob(value)
+        if len(blob) > self.capacity:
+            raise ValueError(
+                f"channel {self.name}: value of {len(blob)}B exceeds capacity "
+                f"{self.capacity}B")
+        version = struct.unpack_from("<Q", self.seg.buf, 0)[0]
+        struct.pack_into("<Q", self.seg.buf, 0, version + 1)  # odd: writing
+        self.seg.buf[_HEADER : _HEADER + len(blob)] = blob
+        struct.pack_into("<Q", self.seg.buf, 8, len(blob))
+        struct.pack_into("<Q", self.seg.buf, 0, version + 2)  # even: sealed
+
+    # -- reader --
+
+    def read(self, timeout: float = 60.0) -> Any:
+        """Blocks until a version newer than the last read is available."""
+        deadline = time.monotonic() + timeout
+        while True:
+            v1 = struct.unpack_from("<Q", self.seg.buf, 0)[0]
+            if v1 % 2 == 0 and v1 > self._last_read_version:
+                length = struct.unpack_from("<Q", self.seg.buf, 8)[0]
+                data = bytes(self.seg.buf[_HEADER : _HEADER + length])
+                v2 = struct.unpack_from("<Q", self.seg.buf, 0)[0]
+                if v1 == v2:  # consistent snapshot
+                    self._last_read_version = v1
+                    return loads_oob(data)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"channel {self.name}: no new value")
+            time.sleep(0.0002)
+
+    def peek_version(self) -> int:
+        return struct.unpack_from("<Q", self.seg.buf, 0)[0]
+
+    def close(self, unlink: bool = False):
+        self.seg.close()
+        if unlink:
+            self.seg.unlink()
+
+
+class IntraProcessChannel:
+    """Same-process channel (reference: intra_process_channel.py)."""
+
+    def __init__(self):
+        import queue
+
+        self._q = queue.Queue(maxsize=1)
+
+    def write(self, value, timeout=None):
+        self._q.put(value, timeout=timeout)
+
+    def read(self, timeout: float = 60.0):
+        return self._q.get(timeout=timeout)
+
+    def close(self, unlink: bool = False):
+        pass

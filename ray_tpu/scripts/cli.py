@@ -1,0 +1,116 @@
+"""CLI: cluster lifecycle + state inspection + microbenchmark.
+
+Reference: python/ray/scripts/scripts.py (`ray start/stop/status/...`,
+`ray microbenchmark`, `ray list ...` via util/state/state_cli.py).
+
+Usage: python -m ray_tpu.scripts.cli <command> [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def cmd_start(args):
+    """Start a head node that outlives this command (ray start --head)."""
+    from ray_tpu._private.node import NodeSupervisor
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    supervisor = NodeSupervisor(resources=resources,
+                                labels=json.loads(args.labels or "{}"))
+    address = supervisor.start_head()
+    with open(args.address_file, "w") as f:
+        f.write(address)
+    print(f"head started; GCS at {address} (address file: {args.address_file})")
+    print("press Ctrl-C to stop")
+    try:
+        signal.pause()
+    except KeyboardInterrupt:
+        pass
+    supervisor.stop()
+
+
+def _connect(args):
+    import ray_tpu
+
+    address = args.address
+    if not address and os.path.exists(args.address_file):
+        address = open(args.address_file).read().strip()
+    if not address:
+        print("no --address given and no address file found", file=sys.stderr)
+        sys.exit(1)
+    ray_tpu.init(address=address)
+    return ray_tpu
+
+
+def cmd_status(args):
+    _connect(args)
+    from ray_tpu.util.state import summarize_cluster
+
+    print(json.dumps(summarize_cluster(), indent=2))
+
+
+def cmd_list(args):
+    _connect(args)
+    from ray_tpu.util import state
+
+    fn = {
+        "nodes": state.list_nodes,
+        "actors": state.list_actors,
+        "jobs": state.list_jobs,
+        "placement-groups": state.list_placement_groups,
+    }[args.what]
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_microbenchmark(args):
+    import ray_tpu
+
+    if args.address or os.path.exists(args.address_file):
+        _connect(args)
+    else:
+        ray_tpu.init(num_cpus=args.num_cpus or None)
+    from ray_tpu._private.microbenchmark import main as bench_main
+
+    for row in bench_main(duration=args.duration):
+        print(json.dumps(row))
+    ray_tpu.shutdown()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    parser.add_argument("--address", default="")
+    parser.add_argument("--address-file", default="/tmp/ray_tpu_sessions/head_address")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head node")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--resources", default="")
+    p.add_argument("--labels", default="")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status", help="cluster summary")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list", help="list cluster entities")
+    p.add_argument("what", choices=["nodes", "actors", "jobs", "placement-groups"])
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("microbenchmark", help="run the core perf suite")
+    p.add_argument("--duration", type=float, default=2.0)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.set_defaults(fn=cmd_microbenchmark)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

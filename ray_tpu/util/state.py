@@ -1,0 +1,60 @@
+"""State API: inspect cluster state (reference: python/ray/util/state/api.py
+list_* :790-1304, backed by the GCS instead of a dashboard process)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _state() -> Dict[str, Any]:
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker().get_state()
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return _state()["nodes"]
+
+
+def list_actors(state_filter: Optional[str] = None) -> List[Dict[str, Any]]:
+    actors = _state()["actors"]
+    if state_filter:
+        actors = [a for a in actors if a["state"] == state_filter]
+    return actors
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return _state()["jobs"]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _state()["pgs"]
+
+
+def get_node_stats(node_address: str) -> Dict[str, Any]:
+    import pickle
+
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod.global_worker()
+    client = core._raylet_client(node_address)
+
+    async def _call():
+        return pickle.loads(await client.call("GetNodeStats", b""))
+
+    return core._run(_call())
+
+
+def summarize_cluster() -> Dict[str, Any]:
+    state = _state()
+    actors_by_state: Dict[str, int] = {}
+    for a in state["actors"]:
+        actors_by_state[a["state"]] = actors_by_state.get(a["state"], 0) + 1
+    return {
+        "num_nodes": sum(1 for n in state["nodes"] if n["alive"]),
+        "num_actors": len(state["actors"]),
+        "actors_by_state": actors_by_state,
+        "num_jobs": len(state["jobs"]),
+        "num_placement_groups": len(state["pgs"]),
+        "uptime_s": state.get("uptime_s", 0.0),
+    }
