@@ -1,0 +1,101 @@
+"""Data-LLM: batch inference processors over Datasets.
+
+Reference: ray.data.llm build_llm_processor
+(llm/_internal/batch/processor/vllm_engine_proc.py + data/llm.py) — a
+processor maps a Dataset of prompts through a shared engine with
+preprocess/postprocess stages. Here the engine lives in one detached actor
+per processor (an engine per map-task would re-compile per block); map tasks
+route their batch of prompts to it, so blocks from many tasks continuously
+batch on the same TPU engine.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+
+
+@ray_tpu.remote
+class _EngineActor:
+    def __init__(self, config_blob: bytes, params_blob: Optional[bytes]):
+        import cloudpickle
+
+        from ray_tpu.llm.engine import JaxLLMEngine
+
+        config = cloudpickle.loads(config_blob)
+        params = cloudpickle.loads(params_blob) if params_blob else None
+        self.engine = JaxLLMEngine(config, params=params)
+
+    def generate(self, prompts, params_blob: bytes):
+        import cloudpickle
+
+        params = cloudpickle.loads(params_blob)
+        outs = self.engine.generate(list(prompts), params)
+        return [{"text": o.text, "token_ids": o.token_ids,
+                 "finish_reason": o.finish_reason} for o in outs]
+
+
+def build_llm_processor(
+    config: LLMConfig,
+    params: Any = None,
+    *,
+    sampling_params: Optional[SamplingParams] = None,
+    preprocess: Optional[Callable[[dict], str]] = None,
+    postprocess: Optional[Callable[[dict, dict], dict]] = None,
+) -> Callable:
+    """Returns ``processor(dataset) -> dataset`` adding generation columns.
+
+    ``preprocess(row) -> prompt`` defaults to ``row["prompt"]``;
+    ``postprocess(row, out) -> row`` defaults to merging ``generated_text``.
+    """
+    import cloudpickle
+
+    sampling_params = sampling_params or SamplingParams()
+    actor_name = f"_llm_proc_{uuid.uuid4().hex[:8]}"
+    cfg_blob = cloudpickle.dumps(config)
+    p_blob = cloudpickle.dumps(params) if params is not None else None
+    opts = dict(config.ray_actor_options) or {"num_cpus": 1.0}
+    # named but NOT detached: the engine actor dies with the driver job, so
+    # an abandoned processor can't pin a TPU forever
+    engine = _EngineActor.options(
+        name=actor_name, get_if_exists=True,
+        num_cpus=opts.get("num_cpus", 1.0),
+        num_tpus=opts.get("num_tpus", 0.0)).remote(cfg_blob, p_blob)
+    sp_blob = cloudpickle.dumps(sampling_params)
+
+    def processor(dataset):
+        def _infer_batch(batch: Dict[str, Any]) -> Dict[str, Any]:
+            import numpy as np
+
+            import ray_tpu as _rt
+
+            eng = _rt.get_actor(actor_name)
+            keys = list(batch.keys())
+            n = len(batch[keys[0]]) if keys else 0
+            rows = [{k: batch[k][i] for k in keys} for i in range(n)]
+            if preprocess is not None:
+                prompts = [preprocess(r) for r in rows]
+            else:
+                prompts = [str(r.get("prompt", "")) for r in rows]
+            outs = _rt.get(eng.generate.remote(prompts, sp_blob),
+                           timeout=600)
+            out_rows = []
+            for r, o in zip(rows, outs):
+                if postprocess is not None:
+                    out_rows.append(postprocess(r, o))
+                else:
+                    r = dict(r)
+                    r["generated_text"] = o["text"]
+                    out_rows.append(r)
+            cols = {k: np.array([row[k] for row in out_rows], dtype=object)
+                    for k in out_rows[0]} if out_rows else {}
+            return cols
+
+        return dataset.map_batches(_infer_batch)
+
+    processor.engine_actor = engine  # keepalive + test access
+    processor.shutdown = lambda: ray_tpu.kill(engine)
+    return processor

@@ -1,0 +1,356 @@
+"""Continuous-batching JAX LLM engine (TPU-native vLLM-engine equivalent).
+
+Reference capability: ray.llm wraps vLLM's AsyncLLMEngine
+(llm/_internal/serve/engines/vllm/vllm_engine.py) — request queue, paged KV
+cache, continuous batching. Here the engine is a host-side scheduler over
+two compiled XLA programs (prefill per shape bucket, one decode step):
+
+- slots: ``max_num_seqs`` concurrent sequences, fixed batch shape so decode
+  is a single cached compilation;
+- pages: a free list of KV pages; sequences allocate pages on demand as they
+  cross page boundaries (admission blocks when no pages are free);
+- scheduling per ``step()``: admit waiting requests into free slots (batched
+  bucketed prefill), then run one decode step for all active slots.
+
+The engine is synchronous and single-threaded by design — actor wrappers
+(serve_llm.LLMServer) give it an async front end.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.llm.config import EngineConfig, LLMConfig, SamplingParams
+from ray_tpu.llm.tokenizer import get_tokenizer
+
+
+@dataclasses.dataclass
+class _Request:
+    request_id: str
+    prompt_tokens: List[int]  # original prompt (never mutated)
+    params: SamplingParams
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    pages: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+    finish_reason: Optional[str] = None
+
+    @property
+    def cache_tokens(self) -> List[int]:
+        """Tokens re-prefilled on (re)admission: prompt + anything already
+        generated before a preemption (vLLM's recompute preemption, without
+        dropping emitted tokens from the output)."""
+        return self.prompt_tokens + self.generated
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    token_ids: List[int]
+    finished: bool
+    finish_reason: Optional[str]
+    text: Optional[str] = None
+
+
+class JaxLLMEngine:
+    """Synchronous continuous-batching engine over the paged-KV model runner."""
+
+    def __init__(self, config: LLMConfig, params: Any = None, seed: int = 0):
+        import jax
+
+        from ray_tpu.llm import model_runner
+
+        self.config = config
+        self.ecfg: EngineConfig = config.engine_config
+        self.mcfg = config.transformer_config()
+        self.tokenizer = get_tokenizer(config.tokenizer)
+        self._mr = model_runner
+        self._jax = jax
+
+        if params is not None:
+            self.params = params
+        elif config.checkpoint_path:
+            self.params = _load_params(config.checkpoint_path)
+        else:
+            self.params = self._init_random_params(seed)
+
+        e = self.ecfg
+        self.cache = model_runner.init_cache(self.mcfg, e.num_pages, e.page_size)
+        B, MP = e.max_num_seqs, e.pages_per_seq
+        self._block_tables = np.zeros((B, MP), np.int32)
+        self._seq_lens = np.zeros(B, np.int32)
+        self._last_tokens = np.zeros(B, np.int32)
+        self._active = np.zeros(B, bool)
+        self._temps = np.zeros(B, np.float32)
+        self._top_ks = np.zeros(B, np.int32)
+        self._top_ps = np.ones(B, np.float32)
+        self._seeds = np.full(B, -1, np.int32)  # -1 = engine-global stream
+        self._slots: List[Optional[_Request]] = [None] * B
+        self._free_pages = collections.deque(range(1, e.num_pages))
+        self._waiting: collections.deque[_Request] = collections.deque()
+        self._requests: Dict[str, _Request] = {}
+        self._rng = jax.random.PRNGKey(seed)
+        self.metrics = {"prefill_tokens": 0, "decode_steps": 0,
+                        "generated_tokens": 0, "preempted": 0}
+
+    # -- params ------------------------------------------------------------
+
+    def _init_random_params(self, seed: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.transformer import Transformer
+
+        import flax.linen as nn
+
+        model = Transformer(self.mcfg)
+        toks = jnp.zeros((1, min(8, self.mcfg.max_seq_len)), jnp.int32)
+        return nn.meta.unbox(model.init(jax.random.PRNGKey(seed), toks))
+
+    # -- request lifecycle -------------------------------------------------
+
+    def add_request(self, request_id: str, prompt: Any,
+                    params: Optional[SamplingParams] = None) -> None:
+        params = params or SamplingParams()
+        if isinstance(prompt, str):
+            tokens = self.tokenizer.encode(prompt)
+        else:
+            tokens = list(prompt)
+        limit = self.ecfg.max_model_len - 1
+        if len(tokens) > limit:
+            tokens = tokens[-limit:]
+        # reject requests the page pool can never satisfy (even alone) —
+        # otherwise admission would livelock retrying forever
+        final_len = min(self.ecfg.max_model_len,
+                        len(tokens) + params.max_tokens)
+        need_total = math.ceil(final_len / self.ecfg.page_size)
+        if need_total > self.ecfg.num_pages - 1:
+            raise ValueError(
+                f"request needs {need_total} KV pages but the engine has "
+                f"{self.ecfg.num_pages - 1}; raise num_pages or lower "
+                f"max_tokens/prompt length")
+        req = _Request(request_id, tokens, params)
+        self._requests[request_id] = req
+        self._waiting.append(req)
+
+    def abort_request(self, request_id: str) -> None:
+        req = self._requests.pop(request_id, None)
+        if req is None:
+            return
+        if req.slot >= 0:
+            self._release(req)
+        else:
+            try:
+                self._waiting.remove(req)
+            except ValueError:
+                pass
+
+    def has_unfinished(self) -> bool:
+        return bool(self._waiting) or self._active.any()
+
+    def num_waiting(self) -> int:
+        return len(self._waiting)
+
+    def num_active(self) -> int:
+        return int(self._active.sum())
+
+    # -- scheduling internals ----------------------------------------------
+
+    def _release(self, req: _Request) -> None:
+        self._free_pages.extend(req.pages)
+        req.pages = []
+        if req.slot >= 0:
+            self._active[req.slot] = False
+            self._slots[req.slot] = None
+            self._seq_lens[req.slot] = 0
+            self._block_tables[req.slot, :] = 0
+            req.slot = -1
+
+    def _try_admit(self) -> List[_Request]:
+        admitted = []
+        free_slots = [i for i, s in enumerate(self._slots) if s is None]
+        while self._waiting and free_slots:
+            req = self._waiting[0]
+            need = max(1, math.ceil(len(req.cache_tokens)
+                                    / self.ecfg.page_size))
+            if len(self._free_pages) < need:
+                break
+            self._waiting.popleft()
+            req.slot = free_slots.pop(0)
+            req.pages = [self._free_pages.popleft() for _ in range(need)]
+            self._slots[req.slot] = req
+            row = self._block_tables[req.slot]
+            row[:] = 0
+            row[:need] = req.pages
+            self._seq_lens[req.slot] = len(req.cache_tokens)
+            p = req.params
+            self._temps[req.slot] = p.temperature
+            self._top_ks[req.slot] = p.top_k
+            self._top_ps[req.slot] = p.top_p
+            self._seeds[req.slot] = -1 if p.seed is None else p.seed
+            admitted.append(req)
+        return admitted
+
+    def _prefill_bucket(self, n: int) -> int:
+        b = self.ecfg.prefill_bucket_min
+        while b < n:
+            b *= 2
+        return min(b, self.ecfg.max_model_len)
+
+    def _ensure_page(self, req: _Request) -> bool:
+        """Allocate the page for the next token position if needed."""
+        pos = int(self._seq_lens[req.slot])
+        need = pos // self.ecfg.page_size + 1
+        if need <= len(req.pages):
+            return True
+        if not self._free_pages:
+            return False
+        page = self._free_pages.popleft()
+        req.pages.append(page)
+        self._block_tables[req.slot, need - 1] = page
+        return True
+
+    def _next_rng(self):
+        self._rng, sub = self._jax.random.split(self._rng)
+        return sub
+
+    def _sample(self, logits) -> np.ndarray:
+        import jax.numpy as jnp
+
+        steps = np.array(
+            [len(s.generated) if s is not None else 0 for s in self._slots],
+            np.int32)
+        toks = self._mr.sample_tokens(
+            logits, self._next_rng(), jnp.asarray(self._temps),
+            jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+            jnp.asarray(self._seeds), jnp.asarray(steps),
+            max_top_k=self.ecfg.max_top_k)
+        return np.asarray(toks)
+
+    # -- the step ----------------------------------------------------------
+
+    def step(self) -> List[RequestOutput]:
+        import jax.numpy as jnp
+
+        outputs: List[RequestOutput] = []
+        e, mr = self.ecfg, self._mr
+        B = e.max_num_seqs
+
+        # 1) admit + batched prefill (one bucketed program, full-B batch)
+        admitted = self._try_admit()
+        if admitted:
+            max_len = max(len(r.cache_tokens) for r in admitted)
+            S = self._prefill_bucket(max_len)
+            toks = np.zeros((B, S), np.int32)
+            lens = np.zeros(B, np.int32)
+            for r in admitted:
+                full = r.cache_tokens
+                toks[r.slot, :len(full)] = full
+                lens[r.slot] = len(full)
+            logits, self.cache = mr.prefill(
+                self.params, self.mcfg, self.cache, jnp.asarray(toks),
+                jnp.asarray(lens), jnp.asarray(self._block_tables))
+            toks_np = self._sample(logits)
+            self.metrics["prefill_tokens"] += int(lens.sum())
+            for r in admitted:
+                self._active[r.slot] = True
+                self._emit(r, int(toks_np[r.slot]), outputs)
+
+        # 2) one decode step for all active slots
+        if self._active.any():
+            # page-boundary allocation; preempt to waiting on exhaustion
+            for req in [s for s in self._slots if s is not None]:
+                if self._active[req.slot] and not self._ensure_page(req):
+                    self.metrics["preempted"] += 1
+                    self._requeue(req)
+            if self._active.any():
+                logits, self.cache = mr.decode_step(
+                    self.params, self.mcfg, self.cache,
+                    jnp.asarray(self._last_tokens), jnp.asarray(self._seq_lens),
+                    jnp.asarray(self._block_tables), jnp.asarray(self._active))
+                toks_np = self._sample(logits)
+                self.metrics["decode_steps"] += 1
+                for req in list(self._slots):
+                    if req is not None and self._active[req.slot]:
+                        self._seq_lens[req.slot] += 1
+                        self._emit(req, int(toks_np[req.slot]), outputs)
+        return outputs
+
+    def _requeue(self, req: _Request) -> None:
+        """Preempt a running request back to the waiting queue; its KV is
+        recomputed from prompt+generated on re-admission (vLLM's recompute
+        preemption). ``generated`` is kept so emitted tokens and the
+        max_tokens budget survive preemption."""
+        self._release(req)
+        self._waiting.appendleft(req)
+
+    def _emit(self, req: _Request, token: int, outputs: List[RequestOutput]):
+        req.generated.append(token)
+        self._last_tokens[req.slot] = token
+        self.metrics["generated_tokens"] += 1
+        eos = self.tokenizer.eos_token_id
+        total = len(req.prompt_tokens) + len(req.generated)
+        if token == eos or token in req.params.stop_token_ids:
+            req.finished, req.finish_reason = True, "stop"
+        elif len(req.generated) >= req.params.max_tokens:
+            req.finished, req.finish_reason = True, "length"
+        elif total >= self.ecfg.max_model_len:
+            req.finished, req.finish_reason = True, "length"
+        if req.finished:
+            self._release(req)
+            self._requests.pop(req.request_id, None)
+        outputs.append(RequestOutput(
+            req.request_id, list(req.generated), req.finished,
+            req.finish_reason))
+
+    # -- convenience -------------------------------------------------------
+
+    def generate(self, prompts: List[Any],
+                 params: Optional[SamplingParams] = None,
+                 decode_text: bool = True) -> List[RequestOutput]:
+        """Blocking batch generation; preserves input order."""
+        ids = [f"gen-{i}-{time.monotonic_ns()}" for i in range(len(prompts))]
+        for rid, prompt in zip(ids, prompts):
+            self.add_request(rid, prompt, params)
+        done: Dict[str, RequestOutput] = {}
+        while self.has_unfinished():
+            for out in self.step():
+                if out.finished:
+                    done[out.request_id] = out
+        results = [done[rid] for rid in ids]
+        if decode_text:
+            for r in results:
+                toks = [t for t in r.token_ids
+                        if t != self.tokenizer.eos_token_id]
+                r.text = self.tokenizer.decode(toks)
+        return results
+
+
+def _load_params(path: str):
+    import os
+
+    import flax.serialization
+
+    fn = path if os.path.isfile(path) else os.path.join(path, "params.msgpack")
+    with open(fn, "rb") as f:
+        blob = f.read()
+    return flax.serialization.msgpack_restore(blob)
+
+
+def save_params(params: Any, path: str) -> str:
+    import os
+
+    import flax.serialization
+
+    os.makedirs(path, exist_ok=True)
+    fn = os.path.join(path, "params.msgpack")
+    with open(fn, "wb") as f:
+        f.write(flax.serialization.msgpack_serialize(
+            flax.serialization.to_state_dict(params)))
+    return fn

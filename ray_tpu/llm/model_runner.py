@@ -1,0 +1,244 @@
+"""Inference forward passes with a paged KV cache (TPU-native vLLM core).
+
+Reference capability: ray.llm serves models through vLLM's PagedAttention
+engine (llm/_internal/serve/engines/vllm/vllm_engine.py). The TPU redesign
+keeps the *cache geometry* idea — KV lives in fixed-shape pages, sequences
+own pages through a block table — but implements it as pure-jnp programs so
+every prefill bucket and the decode step are each ONE compiled XLA program
+with static shapes (no dynamic shapes, no host sync inside the step).
+
+Layout:
+- ``k_pages``/``v_pages``: [n_layers, num_pages, page_size, n_kv_heads, hd]
+- ``block_tables``:        [max_num_seqs, pages_per_seq] int32 page ids
+- page 0 is scratch: masked-out writes (padding, inactive slots) land there.
+
+The decode step gathers each slot's pages into a [B, Lmax] view and runs
+grouped-query attention against it; the gather is a single XLA dynamic-gather
+that TPUs handle well. A pallas paged-attention kernel can swap in underneath
+without changing the cache layout.
+
+Weights come from ``ray_tpu.models.transformer.Transformer`` — this module
+reads the same param pytree (checkpoint-compatible with training).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import TransformerConfig, _rope
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, NP, P, KVH, HD]
+    v: jax.Array
+
+
+def init_cache(cfg: TransformerConfig, num_pages: int, page_size: int) -> KVCache:
+    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype))
+
+
+# ---------------------------------------------------------------------------
+# shared layer math (mirrors models/transformer.py, reading its param tree)
+# ---------------------------------------------------------------------------
+
+
+def _rmsnorm(x, scale, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (norm * scale).astype(x.dtype)
+
+
+def _mlp(x, p, dtype):
+    gate = jnp.einsum("...d,df->...f", x, p["gate_proj"]["kernel"].astype(dtype))
+    up = jnp.einsum("...d,df->...f", x, p["up_proj"]["kernel"].astype(dtype))
+    hidden = jax.nn.silu(gate) * up
+    return jnp.einsum("...f,fd->...d", hidden, p["down_proj"]["kernel"].astype(dtype))
+
+
+def _qkv(x, p, cfg, positions):
+    dtype = cfg.dtype
+    q = jnp.einsum("...d,dhk->...hk", x, p["q_proj"]["kernel"].astype(dtype))
+    k = jnp.einsum("...d,dhk->...hk", x, p["k_proj"]["kernel"].astype(dtype))
+    v = jnp.einsum("...d,dhk->...hk", x, p["v_proj"]["kernel"].astype(dtype))
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _scatter_kv(cache_layer, new, flat_idx):
+    """Write new KV rows into the flat page view at flat_idx (0 = scratch)."""
+    L_dims = cache_layer.shape  # (NP, P, KVH, HD)
+    flat = cache_layer.reshape(L_dims[0] * L_dims[1], L_dims[2], L_dims[3])
+    flat = flat.at[flat_idx.reshape(-1)].set(
+        new.reshape(-1, new.shape[-2], new.shape[-1]), mode="drop")
+    return flat.reshape(L_dims)
+
+
+# ---------------------------------------------------------------------------
+# prefill
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def prefill(params: Any, cfg: TransformerConfig, cache: KVCache,
+            tokens: jax.Array, lengths: jax.Array,
+            block_tables: jax.Array) -> Tuple[jax.Array, KVCache]:
+    """Run the prompt forward, write KV pages, return last-position logits.
+
+    tokens: [B, S] padded with PAD after `lengths`; block_tables: [B, MP].
+    Returns logits [B, vocab] at position lengths-1 and the updated cache.
+    """
+    from ray_tpu.ops.attention import attention as attention_op
+
+    p = params["params"]
+    B, S = tokens.shape
+    P = cache.k.shape[2]
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+    in_prompt = positions < lengths[:, None]
+    # padding tokens scatter to scratch page 0
+    page_for = jnp.take_along_axis(
+        block_tables, (positions // P).astype(jnp.int32), axis=1)
+    flat_idx = jnp.where(in_prompt, page_for * P + positions % P, 0)
+
+    x = p["embed"].astype(cfg.dtype)[tokens]
+    new_k, new_v = cache.k, cache.v
+    for i in range(cfg.n_layers):
+        lp = p[f"layer_{i}"]
+        h = _rmsnorm(x, lp["attn_norm"]["scale"])
+        q, k, v = _qkv(h, lp["attn"], cfg, positions)
+        new_k = new_k.at[i].set(_scatter_kv(new_k[i], k, flat_idx))
+        new_v = new_v.at[i].set(_scatter_kv(new_v[i], v, flat_idx))
+        if cfg.n_kv_heads != cfg.n_heads:
+            rep = cfg.n_heads // cfg.n_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn = attention_op(q, k, v, causal=True, impl=cfg.attention_impl)
+        attn = jnp.einsum("...hk,hkd->...d",
+                          attn, lp["attn"]["o_proj"]["kernel"].astype(cfg.dtype))
+        h2 = x + attn
+        x = h2 + _mlp(_rmsnorm(h2, lp["mlp_norm"]["scale"]), lp["mlp"], cfg.dtype)
+
+    # hidden at the last prompt position only -> [B, d]
+    last = jnp.take_along_axis(
+        x, jnp.maximum(lengths - 1, 0)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    last = _rmsnorm(last, p["final_norm"]["scale"])
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bd,vd->bv", last, p["embed"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bd,dv->bv", last, p["lm_head"].astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v)
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def decode_step(params: Any, cfg: TransformerConfig, cache: KVCache,
+                last_tokens: jax.Array, seq_lens: jax.Array,
+                block_tables: jax.Array, active: jax.Array
+                ) -> Tuple[jax.Array, KVCache]:
+    """One batched decode step over all slots: [B] tokens -> [B, vocab].
+
+    Inactive slots compute garbage into scratch page 0. The new token's KV is
+    written at position seq_lens before attention, so the mask is
+    pos <= seq_lens.
+    """
+    p = params["params"]
+    B = last_tokens.shape[0]
+    L, NP, P, KVH, HD = cache.k.shape
+    MP = block_tables.shape[1]
+    Lmax = MP * P
+    G = cfg.n_heads // cfg.n_kv_heads
+
+    positions = seq_lens[:, None].astype(jnp.int32)  # [B, 1]
+    cur_page = jnp.take_along_axis(block_tables, positions // P, axis=1)[:, 0]
+    flat_write = jnp.where(active, cur_page * P + seq_lens % P, 0)[:, None]  # [B,1]
+    # gather view: every slot's pages flattened to [B, Lmax]
+    gather_idx = (block_tables[:, :, None] * P
+                  + jnp.arange(P, dtype=jnp.int32)[None, None]).reshape(B, Lmax)
+    kv_mask = (jnp.arange(Lmax, dtype=jnp.int32)[None] <= seq_lens[:, None]) \
+        & active[:, None]
+    scale = 1.0 / (HD ** 0.5)
+
+    x = p["embed"].astype(cfg.dtype)[last_tokens[:, None]]  # [B, 1, d]
+    new_k, new_v = cache.k, cache.v
+    for i in range(cfg.n_layers):
+        lp = p[f"layer_{i}"]
+        h = _rmsnorm(x, lp["attn_norm"]["scale"])
+        q, k, v = _qkv(h, lp["attn"], cfg, positions)  # q [B,1,H,hd]
+        new_k = new_k.at[i].set(_scatter_kv(new_k[i], k, flat_write))
+        new_v = new_v.at[i].set(_scatter_kv(new_v[i], v, flat_write))
+        flat_k = new_k[i].reshape(NP * P, KVH, HD)
+        flat_v = new_v[i].reshape(NP * P, KVH, HD)
+        k_all = flat_k[gather_idx]  # [B, Lmax, KVH, HD]
+        v_all = flat_v[gather_idx]
+        # grouped-query attention without materializing repeated heads
+        qg = q[:, 0].reshape(B, KVH, G, HD)
+        scores = jnp.einsum("bkgd,blkd->bkgl", qg, k_all,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(kv_mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bkgl,blkd->bkgd", probs, v_all)
+        attn = attn.reshape(B, 1, cfg.n_heads, HD)
+        attn = jnp.einsum("...hk,hkd->...d",
+                          attn, lp["attn"]["o_proj"]["kernel"].astype(cfg.dtype))
+        h2 = x + attn
+        x = h2 + _mlp(_rmsnorm(h2, lp["mlp_norm"]["scale"]), lp["mlp"], cfg.dtype)
+
+    last = _rmsnorm(x[:, 0], p["final_norm"]["scale"])
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bd,vd->bv", last, p["embed"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bd,dv->bv", last, p["lm_head"].astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+    return logits.astype(jnp.float32), KVCache(new_k, new_v)
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("max_top_k",))
+def sample_tokens(logits: jax.Array, rng: jax.Array, temps: jax.Array,
+                  top_ks: jax.Array, top_ps: jax.Array,
+                  seeds: jax.Array, steps: jax.Array,
+                  max_top_k: int = 64) -> jax.Array:
+    """Per-slot sampling: greedy when temp==0, else temp/top-k/top-p over a
+    static top-``max_top_k`` shortlist (keeps the program shape static).
+
+    ``seeds[b] >= 0`` gives that slot its own reproducible stream
+    (PRNGKey(seed) folded with the slot's step count), independent of batch
+    composition; ``seeds[b] < 0`` draws from the engine-global stream."""
+    B, V = logits.shape
+    K = min(max_top_k, V)
+    greedy = jnp.argmax(logits, axis=-1)
+
+    vals, idx = jax.lax.top_k(logits, K)  # [B, K] descending
+    safe_t = jnp.maximum(temps, 1e-6)[:, None]
+    scaled = vals / safe_t
+    ranks = jnp.arange(K, dtype=jnp.int32)[None]
+    k_lim = jnp.where(top_ks <= 0, K, jnp.minimum(top_ks, K))[:, None]
+    mask = ranks < k_lim
+    probs = jax.nn.softmax(jnp.where(mask, scaled, -1e30), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens whose cumulative prob before them is < top_p
+    mask = mask & ((cum - probs) < top_ps[:, None])
+    final = jnp.where(mask, scaled, -1e30)
+
+    global_keys = jax.random.split(rng, B)
+    seeded_keys = jax.vmap(
+        lambda s, st: jax.random.fold_in(jax.random.PRNGKey(s), st)
+    )(jnp.maximum(seeds, 0).astype(jnp.uint32), steps.astype(jnp.uint32))
+    keys = jnp.where((seeds >= 0)[:, None], seeded_keys, global_keys)
+    sampled_pos = jax.vmap(jax.random.categorical)(keys, final)
+    sampled = jnp.take_along_axis(idx, sampled_pos[:, None], axis=1)[:, 0]
+    return jnp.where(temps <= 0, greedy, sampled).astype(jnp.int32)

@@ -1,0 +1,151 @@
+"""Serve-LLM: LLMServer deployments + OpenAI-style app builder.
+
+Reference: llm/_internal/serve/core/server/llm_server.py (LLMServer
+deployment wrapping an engine), build_openai_app (OpenAI-compatible
+ingress). Each replica owns one ``JaxLLMEngine``; requests are enqueued to
+the engine and a single pump task drives ``engine.step()`` while anything is
+unfinished, so concurrent requests continuously batch on the TPU.
+
+Prefix-aware routing (reference: routing_policies/prefix_aware/): the
+``LLMHandle`` hashes a prompt prefix to prefer a consistent replica, which
+keeps likely-shared KV prefixes on the same engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.llm.config import LLMConfig, SamplingParams
+from ray_tpu.serve import api as serve_api
+
+
+class LLMServer:
+    """Deployment callable owning one engine (reference: llm_server.py)."""
+
+    def __init__(self, config: LLMConfig, params_blob: Optional[bytes] = None):
+        from ray_tpu.llm.engine import JaxLLMEngine
+
+        params = None
+        if params_blob is not None:
+            import cloudpickle
+
+            params = cloudpickle.loads(params_blob)
+        self.config = config
+        self.engine = JaxLLMEngine(config, params=params)
+        self._futures: Dict[str, asyncio.Future] = {}
+        self._pump_task: Optional[asyncio.Task] = None
+
+    async def _pump(self):
+        loop = asyncio.get_event_loop()
+        try:
+            while self.engine.has_unfinished():
+                outputs = await loop.run_in_executor(None, self.engine.step)
+                for out in outputs:
+                    if out.finished and out.request_id in self._futures:
+                        fut = self._futures.pop(out.request_id)
+                        if not fut.done():
+                            toks = [t for t in out.token_ids
+                                    if t != self.engine.tokenizer.eos_token_id]
+                            fut.set_result(
+                                {"token_ids": out.token_ids,
+                                 "text": self.engine.tokenizer.decode(toks),
+                                 "finish_reason": out.finish_reason})
+                await asyncio.sleep(0)
+        except Exception as e:
+            # fail every pending request rather than hanging its caller
+            for rid, fut in list(self._futures.items()):
+                if not fut.done():
+                    fut.set_exception(RuntimeError(f"engine step failed: {e}"))
+                self.engine.abort_request(rid)
+            self._futures.clear()
+            raise
+        finally:
+            self._pump_task = None
+
+    async def _submit(self, prompt: Any, params: SamplingParams) -> dict:
+        rid = uuid.uuid4().hex
+        fut = asyncio.get_event_loop().create_future()
+        self._futures[rid] = fut
+        self.engine.add_request(rid, prompt, params)
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.ensure_future(self._pump())
+        return await fut
+
+    async def completions(self, prompt: str, *, max_tokens: int = 64,
+                          temperature: float = 0.0, top_k: int = 0,
+                          top_p: float = 1.0) -> dict:
+        params = SamplingParams(max_tokens=max_tokens, temperature=temperature,
+                                top_k=top_k, top_p=top_p)
+        return await self._submit(prompt, params)
+
+    async def chat(self, messages: List[dict], **kw) -> dict:
+        prompt = "".join(
+            f"<{m.get('role', 'user')}>{m.get('content', '')}" for m in messages
+        ) + "<assistant>"
+        return await self.completions(prompt, **kw)
+
+    async def __call__(self, body: dict) -> dict:
+        """OpenAI-ish JSON entry point (used by the HTTP proxy)."""
+        kw = {k: body[k] for k in ("max_tokens", "temperature", "top_k", "top_p")
+              if k in body}
+        if "messages" in body:
+            out = await self.chat(body["messages"], **kw)
+            return {"id": uuid.uuid4().hex, "object": "chat.completion",
+                    "choices": [{"index": 0,
+                                 "message": {"role": "assistant",
+                                             "content": out["text"]},
+                                 "finish_reason": out["finish_reason"]}]}
+        out = await self.completions(body.get("prompt", ""), **kw)
+        return {"id": uuid.uuid4().hex, "object": "text_completion",
+                "choices": [{"index": 0, "text": out["text"],
+                             "finish_reason": out["finish_reason"]}]}
+
+    def engine_metrics(self) -> dict:
+        return dict(self.engine.metrics)
+
+
+def build_llm_deployment(config: LLMConfig, params: Any = None,
+                         name: Optional[str] = None) -> serve_api.Application:
+    """Deployment app for one LLMConfig (reference: build_llm_deployment)."""
+    opts = dict(config.ray_actor_options) or {"num_cpus": 1.0}
+    params_blob = None
+    if params is not None:
+        import cloudpickle
+
+        params_blob = cloudpickle.dumps(params)
+    dep = serve_api.deployment(
+        LLMServer, name=name or f"llm:{config.model_id}",
+        num_replicas=config.num_replicas,
+        max_ongoing_requests=config.engine_config.max_num_seqs * 2,
+        ray_actor_options=opts)
+    return dep.bind(config, params_blob)
+
+
+def build_openai_app(configs: List[LLMConfig], params: Any = None
+                     ) -> Dict[str, serve_api.DeploymentHandle]:
+    """Deploy one LLMServer per config; returns name->handle (the HTTP proxy
+    then serves POST /<name> with OpenAI-style bodies)."""
+    handles = {}
+    for cfg in configs:
+        app = build_llm_deployment(cfg, params=params)
+        handles[app.deployment.name] = serve_api.run(app)
+    return handles
+
+
+class LLMHandle:
+    """Prefix-aware handle: same prompt prefix -> same replica when healthy,
+    keeping likely-shared KV prefixes on one engine (reference:
+    routing_policies/prefix_aware/prefix_aware_router.py)."""
+
+    def __init__(self, deployment_name: str, prefix_len: int = 64):
+        self._inner = serve_api.DeploymentHandle(deployment_name)
+        self._prefix_len = prefix_len
+
+    def remote(self, body: dict):
+        prompt = body.get("prompt") or str(body.get("messages", ""))
+        if prompt:
+            return self._inner.remote_with_key(prompt[: self._prefix_len], body)
+        return self._inner.remote(body)

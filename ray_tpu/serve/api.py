@@ -291,6 +291,23 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         replica = self._pick()
+        return self._dispatch(replica, args, kwargs)
+
+    def remote_with_key(self, routing_key: str, *args, **kwargs):
+        """Consistent routing: the same key prefers the same replica (used by
+        prefix-aware LLM routing; falls back to pow-2 with one replica)."""
+        import hashlib
+
+        self._refresh()
+        if len(self._replicas) > 1:
+            digest = hashlib.md5(routing_key.encode()).digest()
+            replica = self._replicas[
+                int.from_bytes(digest[:4], "little") % len(self._replicas)]
+        else:
+            replica = self._pick()
+        return self._dispatch(replica, args, kwargs)
+
+    def _dispatch(self, replica, args, kwargs):
         # pending counters decay by zeroing at each periodic refresh
         self._pending[replica] = self._pending.get(replica, 0) + 1
         blob = cloudpickle.dumps((args, kwargs))
