@@ -10,6 +10,21 @@ import jax
 import jax.numpy as jnp
 
 
+class QNetwork(nn.Module):
+    """State-action value MLP for DQN-family algorithms."""
+
+    action_dim: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        x = obs
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"torso_{i}")(x))
+        return nn.Dense(self.action_dim, name="q",
+                        kernel_init=nn.initializers.orthogonal(0.01))(x)
+
+
 class ActorCritic(nn.Module):
     action_dim: int
     hidden: Sequence[int] = (64, 64)

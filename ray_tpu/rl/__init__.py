@@ -1,9 +1,21 @@
 """ray_tpu.rl: reinforcement learning (reference: rllib core loop).
 
-Round 1 ships PPO (env-runner actors + jax learner); the Algorithm/Config
-shape mirrors rllib's AlgorithmConfig.build() -> Algorithm.train().
+Algorithms follow rllib's ``AlgorithmConfig.build() -> Algorithm.train()``
+shape (algorithms/algorithm.py:212): PPO (sync on-policy), DQN (replay +
+target nets, PER, double-Q), IMPALA (async sampling + aggregator actors +
+V-trace). All learners are jitted jax programs; env runners are actors.
 """
 
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig, PPOLearner
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 
-__all__ = ["PPO", "PPOConfig", "PPOLearner"]
+__all__ = [
+    "Algorithm", "AlgorithmConfig",
+    "PPO", "PPOConfig", "PPOLearner",
+    "DQN", "DQNConfig",
+    "IMPALA", "IMPALAConfig",
+    "ReplayBuffer", "PrioritizedReplayBuffer",
+]

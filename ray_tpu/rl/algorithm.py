@@ -1,0 +1,73 @@
+"""Algorithm base API (reference: rllib/algorithms/algorithm.py:212).
+
+``AlgorithmConfig.build() -> Algorithm`` with ``train() -> result dict``,
+checkpointing, and Tune-compatibility (an Algorithm is a valid trainable:
+``tune.Tuner(lambda cfg: ...)`` can call train() in a loop and report).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AlgorithmConfig:
+    env: str = "CartPole-v1"
+    seed: int = 0
+
+    algo_cls = None  # set by subclasses
+
+    def build(self) -> "Algorithm":
+        if self.algo_cls is None:
+            raise NotImplementedError("config does not name an algo_cls")
+        return self.algo_cls(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+class Algorithm:
+    """Base trainable: subclasses implement ``training_step`` and params
+    accessors; ``train`` adds iteration bookkeeping."""
+
+    def __init__(self, config):
+        self.config = config
+        self.iteration = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        result = self.training_step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        return result
+
+    # -- checkpointing (reference: rllib/utils/checkpoints.py Checkpointable)
+
+    def get_state(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> str:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump({"iteration": self.iteration,
+                         "state": self.get_state()}, f)
+        return checkpoint_dir
+
+    def restore_from_checkpoint(self, checkpoint_dir: str) -> None:
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self.iteration = blob["iteration"]
+        self.set_state(blob["state"])
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,256 @@
+"""DQN: epsilon-greedy env runners + replay buffer + double-DQN jax learner.
+
+Reference: rllib/algorithms/dqn (training_step samples from env runners into
+an episode replay buffer, updates with target-network TD, syncs target every
+``target_network_update_freq`` steps). TPU-first: the Q-update (double-DQN
+target, huber loss, PER weighting) is one jitted program; replay stays in
+host numpy (see replay_buffer.py).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+
+@dataclass
+class DQNConfig(AlgorithmConfig):
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 2
+    rollout_length: int = 64
+    buffer_capacity: int = 50_000
+    prioritized_replay: bool = True
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    updates_per_iteration: int = 32
+    gamma: float = 0.99
+    lr: float = 5e-4
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 5_000
+    target_update_freq: int = 500  # env steps between target syncs
+    hidden: tuple = (64, 64)
+    double_q: bool = True
+
+    @property
+    def algo_cls(self):
+        return DQN
+
+
+@ray_tpu.remote(num_cpus=1)
+class _DQNRunner:
+    """Vector env sampler emitting (s, a, r, s', done) transitions."""
+
+    def __init__(self, config_blob: bytes, worker_index: int):
+        import cloudpickle as _cp
+
+        from ray_tpu.rl.env_runner import EpisodeTracker, make_vec_env
+
+        self.cfg: DQNConfig = _cp.loads(config_blob)
+        self.envs, self.obs = make_vec_env(
+            self.cfg.env, self.cfg.num_envs_per_runner,
+            self.cfg.seed + worker_index * 1000)
+        self._rng = np.random.default_rng(self.cfg.seed * 131 + worker_index)
+        self._apply = None
+        self.episodes = EpisodeTracker(self.cfg.num_envs_per_runner)
+
+    def _q(self):
+        if self._apply is None:
+            from ray_tpu.utils import import_jax
+
+            jax = import_jax()
+
+            from ray_tpu.models.actor_critic import QNetwork
+
+            n_act = int(self.envs.single_action_space.n)
+            model = QNetwork(n_act, self.cfg.hidden)
+            self._apply = jax.jit(
+                lambda params, obs: model.apply({"params": params}, obs))
+        return self._apply
+
+    def sample(self, params, epsilon: float) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        from ray_tpu.rl.env_runner import true_next_obs
+
+        apply = self._q()
+        T, N = self.cfg.rollout_length, self.cfg.num_envs_per_runner
+        shp = self.obs.shape[1:]
+        out = {k: np.zeros((T, N) + (shp if k in ("obs", "next_obs") else ()),
+                           np.float32)
+               for k in ("obs", "next_obs", "rewards", "dones")}
+        out["actions"] = np.zeros((T, N), np.int32)
+        for t in range(T):
+            q = np.asarray(apply(params, jnp.asarray(self.obs, jnp.float32)))
+            action = q.argmax(-1)
+            explore = self._rng.random(N) < epsilon
+            action = np.where(
+                explore, self._rng.integers(0, q.shape[-1], N), action)
+            nxt, rew, term, trunc, info = self.envs.step(action)
+            done = np.logical_or(term, trunc)
+            out["obs"][t] = self.obs
+            # TD target state: the terminal obs, not the autoreset obs
+            out["next_obs"][t] = true_next_obs(nxt, done, info)
+            out["actions"][t] = action
+            out["rewards"][t] = rew
+            # bootstrap through truncation: only true termination zeroes the
+            # next-state value; truncation bootstraps V(final_obs)
+            out["dones"][t] = term.astype(np.float32)
+            self.obs = nxt
+            self.episodes.step(rew, done)
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
+        res = {k: flat(v) for k, v in out.items()}
+        res["episode_returns"] = self.episodes.pop()
+        return res
+
+
+class DQN(Algorithm):
+    def __init__(self, cfg: DQNConfig):
+        import cloudpickle
+
+        import gymnasium as gym
+
+        super().__init__(cfg)
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.actor_critic import QNetwork
+
+        probe = gym.make(cfg.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+
+        self.model = QNetwork(n_actions, cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = self.model.init(key, jnp.zeros((1, obs_dim)))["params"]
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._jax = jax
+
+        def loss_fn(params, target_params, batch):
+            q = self.model.apply({"params": params}, batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None].astype(jnp.int32), axis=-1)[:, 0]
+            q_next_t = self.model.apply({"params": target_params},
+                                        batch["next_obs"])
+            if cfg.double_q:
+                q_next_online = self.model.apply({"params": params},
+                                                 batch["next_obs"])
+                best = jnp.argmax(q_next_online, axis=-1)
+            else:
+                best = jnp.argmax(q_next_t, axis=-1)
+            q_next = jnp.take_along_axis(q_next_t, best[:, None], axis=-1)[:, 0]
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) \
+                * jax.lax.stop_gradient(q_next)
+            td = q_sel - target
+            huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
+                              jnp.abs(td) - 0.5)
+            w = batch.get("weights", jnp.ones_like(td))
+            return (w * huber).mean(), td
+
+        def update(params, target_params, opt_state, batch):
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._update = jax.jit(update)
+
+        buf_cls = PrioritizedReplayBuffer if cfg.prioritized_replay \
+            else ReplayBuffer
+        self.buffer = buf_cls(cfg.buffer_capacity, seed=cfg.seed)
+        blob = cloudpickle.dumps(cfg)
+        self.runners = [_DQNRunner.remote(blob, i)
+                        for i in range(cfg.num_env_runners)]
+        self.env_steps = 0
+        self._steps_since_target_sync = 0
+        self._return_window: List[float] = []
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.env_steps / max(cfg.epsilon_decay_steps, 1))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        cfg = self.config
+        t0 = time.time()
+        params_np = self._jax.tree.map(np.asarray, self.params)
+        eps = self._epsilon()
+        rollouts = ray_tpu.get(
+            [r.sample.remote(params_np, eps) for r in self.runners],
+            timeout=600)
+        for r in rollouts:
+            self._return_window.extend(r.pop("episode_returns").tolist())
+            n = len(r["obs"])
+            self.buffer.add_batch(r)
+            self.env_steps += n
+            self._steps_since_target_sync += n
+        self._return_window = self._return_window[-100:]
+
+        loss_val = 0.0
+        if len(self.buffer) >= cfg.learning_starts:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.buffer.sample(cfg.train_batch_size)
+                idx = batch.pop("idx", None)
+                jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+                self.params, self.opt_state, loss, td = self._update(
+                    self.params, self.target_params, self.opt_state, jbatch)
+                if idx is not None:
+                    self.buffer.update_priorities(idx, np.asarray(td))
+                loss_val = float(loss)
+            if self._steps_since_target_sync >= cfg.target_update_freq:
+                self.target_params = self._jax.tree.map(
+                    lambda x: x, self.params)
+                self._steps_since_target_sync = 0
+        return {
+            "episode_return_mean": (float(np.mean(self._return_window))
+                                    if self._return_window else 0.0),
+            "num_env_steps_sampled": self.env_steps,
+            "epsilon": eps,
+            "loss": loss_val,
+            "buffer_size": len(self.buffer),
+            "steps_per_sec": (sum(len(r["obs"]) for r in rollouts)
+                              / max(time.time() - t0, 1e-6)),
+        }
+
+    def get_state(self):
+        to_np = lambda t: self._jax.tree.map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "target": to_np(self.target_params),
+                "opt_state": to_np(self.opt_state),
+                "buffer": self.buffer.state(),
+                "env_steps": self.env_steps,
+                "steps_since_target_sync": self._steps_since_target_sync}
+
+    def set_state(self, state):
+        self.params = state["params"]
+        self.target_params = state["target"]
+        self.opt_state = state["opt_state"]
+        self.buffer.set_state(state["buffer"])
+        self.env_steps = state["env_steps"]
+        self._steps_since_target_sync = state["steps_since_target_sync"]
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

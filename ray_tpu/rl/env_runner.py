@@ -1,0 +1,65 @@
+"""Shared vector-env plumbing for env-runner actors.
+
+Reference: rllib env/single_agent_env_runner.py:68. One place for the
+autoreset semantics so every algorithm gets them right:
+
+- SAME_STEP autoreset (gymnasium >= 1.0): the obs returned at a done step is
+  the NEXT episode's reset obs; the true terminal obs is in
+  ``infos["final_obs"]``. ``true_next_obs`` recovers it so TD targets
+  bootstrap from the state that was actually reached.
+- ``term`` vs ``trunc``: only true termination should zero the bootstrap;
+  truncation (time limits) should bootstrap from V(final_obs).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def make_vec_env(env_name: str, num_envs: int, seed: int):
+    import gymnasium as gym
+
+    fns = [lambda: gym.make(env_name) for _ in range(num_envs)]
+    try:
+        from gymnasium.vector import AutoresetMode
+
+        envs = gym.vector.SyncVectorEnv(fns,
+                                        autoreset_mode=AutoresetMode.SAME_STEP)
+    except (ImportError, TypeError):
+        envs = gym.vector.SyncVectorEnv(fns)
+    obs, _ = envs.reset(seed=seed)
+    return envs, obs
+
+
+def true_next_obs(step_obs: np.ndarray, done: np.ndarray, info: dict
+                  ) -> np.ndarray:
+    """Next-state observations for TD targets: where an episode just ended,
+    substitute the terminal obs recorded in info for the reset obs."""
+    finals = info.get("final_obs", info.get("final_observation"))
+    if finals is None or not np.any(done):
+        return step_obs
+    out = np.array(step_obs, copy=True)
+    for i in np.nonzero(done)[0]:
+        if finals[i] is not None:
+            out[i] = finals[i]
+    return out
+
+
+class EpisodeTracker:
+    """Accumulates per-env returns; pops finished-episode returns."""
+
+    def __init__(self, num_envs: int):
+        self._acc = np.zeros(num_envs)
+        self._finished: List[float] = []
+
+    def step(self, rewards: np.ndarray, done: np.ndarray) -> None:
+        self._acc += rewards
+        for i in np.nonzero(done)[0]:
+            self._finished.append(float(self._acc[i]))
+            self._acc[i] = 0.0
+
+    def pop(self) -> np.ndarray:
+        out, self._finished = self._finished, []
+        return np.asarray(out, np.float32)

@@ -1,0 +1,283 @@
+"""IMPALA: async env-runners + aggregator actors + V-trace jax learner.
+
+Reference: rllib/algorithms/impala/impala.py:605 (async sampling loop) and
+:133-148 (aggregator actors). Runners sample continuously with whatever
+params they last received; the learner corrects the resulting policy lag
+with V-trace importance weighting (Espeholt et al. 2018), computed inside
+one jitted program via ``lax.scan`` over the time axis — no host loop.
+Aggregator actors stack several rollouts into one learner batch off the
+driver, so the driver only moves object refs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+
+
+@dataclass
+class IMPALAConfig(AlgorithmConfig):
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 2
+    rollout_length: int = 64
+    num_rollouts_per_update: int = 2  # aggregated per learner batch
+    gamma: float = 0.99
+    lr: float = 1e-3
+    entropy_coef: float = 0.01
+    vf_coef: float = 0.25
+    vtrace_rho_clip: float = 1.0
+    vtrace_c_clip: float = 1.0
+    hidden: tuple = (64, 64)
+    num_aggregators: int = 1
+
+    @property
+    def algo_cls(self):
+        return IMPALA
+
+
+@ray_tpu.remote(num_cpus=1)
+class _ImpalaRunner:
+    """Time-major rollout sampler carrying behavior logp for V-trace."""
+
+    def __init__(self, config_blob: bytes, worker_index: int):
+        import cloudpickle as _cp
+
+        from ray_tpu.rl.env_runner import EpisodeTracker, make_vec_env
+
+        self.cfg: IMPALAConfig = _cp.loads(config_blob)
+        self.envs, self.obs = make_vec_env(
+            self.cfg.env, self.cfg.num_envs_per_runner,
+            self.cfg.seed + worker_index * 1000)
+        self._apply = None
+        self._rng_seed = self.cfg.seed * 7919 + worker_index
+        self.episodes = EpisodeTracker(self.cfg.num_envs_per_runner)
+
+    def _policy(self):
+        if self._apply is None:
+            from ray_tpu.utils import import_jax
+
+            jax = import_jax()
+
+            from ray_tpu.models.actor_critic import ActorCritic
+
+            n_act = int(self.envs.single_action_space.n)
+            model = ActorCritic(n_act, self.cfg.hidden)
+            self._apply = jax.jit(
+                lambda params, obs: model.apply({"params": params}, obs))
+        return self._apply
+
+    def sample(self, params) -> Dict[str, np.ndarray]:
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+
+        apply = self._policy()
+        T, N = self.cfg.rollout_length, self.cfg.num_envs_per_runner
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        key = jax.random.PRNGKey(self._rng_seed)
+        self._rng_seed += 1
+        for t in range(T):
+            logits, _ = apply(params, jnp.asarray(self.obs, jnp.float32))
+            key, sub = jax.random.split(key)
+            action = jax.random.categorical(sub, logits)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, action[:, None], axis=-1)[:, 0]
+            action = np.asarray(action)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            self.obs, rew, term, trunc, _ = self.envs.step(action)
+            done = np.logical_or(term, trunc)
+            rew_buf[t] = rew
+            # cut the V-trace recursion at BOTH termination and truncation:
+            # values may not leak across an episode boundary (obs[t+1] is the
+            # next episode's reset obs under same-step autoreset). Treating
+            # truncation as termination biases time-limited envs slightly but
+            # keeps targets on-episode.
+            done_buf[t] = done.astype(np.float32)
+            self.episodes.step(rew, done)
+        return {
+            "obs": obs_buf, "actions": act_buf, "behavior_logp": logp_buf,
+            "rewards": rew_buf, "dones": done_buf,
+            "last_obs": np.asarray(self.obs, np.float32),
+            "episode_returns": self.episodes.pop(),
+        }
+
+
+@ray_tpu.remote(num_cpus=0.5)
+class _Aggregator:
+    """Stacks rollouts into one [T, B] learner batch off the driver
+    (reference: impala.py:133-148 aggregator actors)."""
+
+    def stack(self, *rollouts) -> Dict[str, np.ndarray]:
+        ep = np.concatenate([r["episode_returns"] for r in rollouts])
+        out = {k: np.concatenate([r[k] for r in rollouts], axis=1)
+               for k in ("obs", "actions", "behavior_logp", "rewards", "dones")}
+        out["last_obs"] = np.concatenate(
+            [r["last_obs"] for r in rollouts], axis=0)
+        out["episode_returns"] = ep
+        return out
+
+
+class IMPALA(Algorithm):
+    def __init__(self, cfg: IMPALAConfig):
+        import cloudpickle
+
+        import gymnasium as gym
+
+        super().__init__(cfg)
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.actor_critic import ActorCritic
+
+        probe = gym.make(cfg.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+        self.model = ActorCritic(n_actions, cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = self.model.init(key, jnp.zeros((1, obs_dim)))["params"]
+        self.opt = optax.chain(optax.clip_by_global_norm(0.5),
+                               optax.adam(cfg.lr))
+        self.opt_state = self.opt.init(self.params)
+        self._jax = jax
+
+        def vtrace(values, last_value, rewards, dones, rhos):
+            """[T, B] inputs -> (vs, pg_adv), scanned backwards in time."""
+            rho_cl = jnp.minimum(rhos, cfg.vtrace_rho_clip)
+            c_cl = jnp.minimum(rhos, cfg.vtrace_c_clip)
+            nonterm = 1.0 - dones
+            values_tp1 = jnp.concatenate(
+                [values[1:], last_value[None]], axis=0)
+            deltas = rho_cl * (rewards + cfg.gamma * values_tp1 * nonterm
+                               - values)
+
+            def body(carry, xs):
+                delta, c, nt, v_tp1 = xs
+                carry = delta + cfg.gamma * nt * c * carry
+                return carry, carry
+
+            _, acc = jax.lax.scan(
+                body, jnp.zeros_like(last_value),
+                (deltas, c_cl, nonterm, values_tp1), reverse=True)
+            vs = values + acc
+            vs_tp1 = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+            pg_adv = rho_cl * (rewards + cfg.gamma * vs_tp1 * nonterm - values)
+            return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+        def loss_fn(params, batch):
+            T, B = batch["actions"].shape
+            obs_all = jnp.concatenate(
+                [batch["obs"].reshape((T * B,) + batch["obs"].shape[2:]),
+                 batch["last_obs"]], axis=0)
+            logits_all, values_all = self.model.apply({"params": params},
+                                                      obs_all)
+            logits = logits_all[: T * B].reshape(T, B, -1)
+            values = values_all[: T * B].reshape(T, B)
+            last_value = values_all[T * B:]
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            rhos = jnp.exp(logp - batch["behavior_logp"])
+            vs, pg_adv = vtrace(values, last_value, batch["rewards"],
+                                batch["dones"], rhos)
+            pg_loss = -(logp * pg_adv).mean()
+            vf_loss = ((values - vs) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg_loss + cfg.vf_coef * vf_loss - cfg.entropy_coef * entropy
+            return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "mean_rho": rhos.mean()}
+
+        def update(params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, **aux}
+
+        self._update = jax.jit(update)
+
+        blob = cloudpickle.dumps(cfg)
+        self.runners = [_ImpalaRunner.remote(blob, i)
+                        for i in range(cfg.num_env_runners)]
+        self.aggregators = [_Aggregator.remote()
+                            for _ in range(cfg.num_aggregators)]
+        self._agg_rr = 0
+        # prime the async pipeline: every runner starts sampling immediately
+        params_np = self._to_np(self.params)
+        self._inflight = {r.sample.remote(params_np): r for r in self.runners}
+        self.env_steps = 0
+        self._return_window: List[float] = []
+
+    def _to_np(self, tree):
+        return self._jax.tree.map(np.asarray, tree)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        cfg = self.config
+        t0 = time.time()
+        want = min(cfg.num_rollouts_per_update, len(self.runners))
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=want,
+                                timeout=600)
+        rollout_refs = []
+        params_np = self._to_np(self.params)
+        for ref in ready:
+            runner = self._inflight.pop(ref)
+            rollout_refs.append(ref)
+            # relaunch with current weights — the runner never idles
+            self._inflight[runner.sample.remote(params_np)] = runner
+        agg = self.aggregators[self._agg_rr % len(self.aggregators)]
+        self._agg_rr += 1
+        batch = ray_tpu.get(agg.stack.remote(*rollout_refs), timeout=600)
+        self._return_window.extend(batch.pop("episode_returns").tolist())
+        self._return_window = self._return_window[-100:]
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, jbatch)
+        steps = int(np.prod(batch["actions"].shape))
+        self.env_steps += steps
+        return {
+            "episode_return_mean": (float(np.mean(self._return_window))
+                                    if self._return_window else 0.0),
+            "num_env_steps_sampled": self.env_steps,
+            "num_rollouts_aggregated": len(rollout_refs),
+            "steps_per_sec": steps / max(time.time() - t0, 1e-6),
+            **{k: float(v) for k, v in metrics.items()},
+        }
+
+    def get_state(self):
+        return {"params": self._to_np(self.params),
+                "opt_state": self._to_np(self.opt_state),
+                "env_steps": self.env_steps}
+
+    def set_state(self, state):
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.env_steps = state["env_steps"]
+
+    def stop(self):
+        for a in list(self.runners) + list(self.aggregators):
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -18,11 +18,11 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 import ray_tpu
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 
 
 @dataclass
-class PPOConfig:
-    env: str = "CartPole-v1"
+class PPOConfig(AlgorithmConfig):
     num_env_runners: int = 2
     num_envs_per_runner: int = 4
     rollout_length: int = 128
@@ -35,10 +35,10 @@ class PPOConfig:
     epochs: int = 8
     num_minibatches: int = 4
     hidden: tuple = (64, 64)
-    seed: int = 0
 
-    def build(self) -> "PPO":
-        return PPO(self)
+    @property
+    def algo_cls(self):
+        return PPO
 
 
 @ray_tpu.remote(num_cpus=1)
@@ -48,26 +48,17 @@ class EnvRunner:
     def __init__(self, config_blob: bytes, worker_index: int):
         import cloudpickle as _cp
 
-        import gymnasium as gym
+        from ray_tpu.rl.env_runner import EpisodeTracker, make_vec_env
 
         self.cfg: PPOConfig = _cp.loads(config_blob)
-        fns = [lambda: gym.make(self.cfg.env)
-               for _ in range(self.cfg.num_envs_per_runner)]
-        try:
-            # same-step autoreset: the obs after a done is the next episode's
-            # reset obs, so every stored transition is a real one (gymnasium
-            # >=1.0 defaults to next-step autoreset, which would poison GAE)
-            from gymnasium.vector import AutoresetMode
-
-            self.envs = gym.vector.SyncVectorEnv(
-                fns, autoreset_mode=AutoresetMode.SAME_STEP)
-        except (ImportError, TypeError):
-            self.envs = gym.vector.SyncVectorEnv(fns)
-        self.obs, _ = self.envs.reset(seed=self.cfg.seed + worker_index * 1000)
+        # same-step autoreset (via make_vec_env): the obs after a done is the
+        # next episode's reset obs, so every stored transition is a real one
+        self.envs, self.obs = make_vec_env(
+            self.cfg.env, self.cfg.num_envs_per_runner,
+            self.cfg.seed + worker_index * 1000)
         self._apply = None
         self._rng_seed = self.cfg.seed * 7919 + worker_index
-        self.episode_returns = np.zeros(self.cfg.num_envs_per_runner)
-        self.finished_returns: List[float] = []
+        self.episodes = EpisodeTracker(self.cfg.num_envs_per_runner)
 
     def _policy(self):
         if self._apply is None:
@@ -115,10 +106,7 @@ class EnvRunner:
             done = np.logical_or(term, trunc)
             rew_buf[t] = rew
             done_buf[t] = done
-            self.episode_returns += rew
-            for i in np.nonzero(done)[0]:
-                self.finished_returns.append(float(self.episode_returns[i]))
-                self.episode_returns[i] = 0.0
+            self.episodes.step(rew, done)
         _, last_value = apply(params, jnp.asarray(self.obs, jnp.float32))
         val_buf[T] = np.asarray(last_value)
         # GAE (reference: rllib postprocessing/advantages)
@@ -131,7 +119,7 @@ class EnvRunner:
             lastgae = delta + self.cfg.gamma * self.cfg.gae_lambda * nonterminal * lastgae
             adv[t] = lastgae
         returns = adv + val_buf[:T]
-        ep_returns, self.finished_returns = self.finished_returns, []
+        ep_returns = self.episodes.pop()
         flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
         return {
             "obs": flat(obs_buf),
@@ -186,9 +174,7 @@ class PPOLearner:
             (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 params, batch)
             updates, opt_state = self.opt.update(grads, opt_state, params)
-            import optax as _optax
-
-            params = _optax.apply_updates(params, updates)
+            params = optax.apply_updates(params, updates)
             return (params, opt_state), {"loss": loss, **aux}
 
         self._update_minibatch = jax.jit(update_minibatch)
@@ -216,7 +202,7 @@ class PPOLearner:
         return self.params
 
 
-class PPO:
+class PPO(Algorithm):
     """Algorithm driver (reference: Algorithm.step at algorithm.py:1189)."""
 
     def __init__(self, cfg: PPOConfig):
@@ -224,6 +210,7 @@ class PPO:
 
         import gymnasium as gym
 
+        super().__init__(cfg)
         self.cfg = cfg
         if not ray_tpu.is_initialized():
             ray_tpu.init()
@@ -235,10 +222,9 @@ class PPO:
         blob = cloudpickle.dumps(cfg)
         self.runners = [EnvRunner.remote(blob, i)
                         for i in range(cfg.num_env_runners)]
-        self.iteration = 0
         self._return_window: List[float] = []
 
-    def train(self) -> Dict[str, Any]:
+    def training_step(self) -> Dict[str, Any]:
         """One iteration: parallel sampling -> PPO update -> weight sync."""
         t0 = time.time()
         params = self.learner.get_params()
@@ -250,12 +236,10 @@ class PPO:
             for k in rollouts[0]
         }
         metrics = self.learner.update(batch)
-        self.iteration += 1
         self._return_window.extend(batch["episode_returns"].tolist())
         self._return_window = self._return_window[-100:]
         steps = len(batch["obs"])
         return {
-            "training_iteration": self.iteration,
             "episode_return_mean": (float(np.mean(self._return_window))
                                     if self._return_window else 0.0),
             "num_env_steps_sampled": steps,
@@ -268,6 +252,14 @@ class PPO:
         import jax
 
         return jax.tree.map(lambda x: np.asarray(x), tree)
+
+    def get_state(self):
+        return {"params": self._jax_to_np(self.learner.params),
+                "opt_state": self._jax_to_np(self.learner.opt_state)}
+
+    def set_state(self, state):
+        self.learner.params = state["params"]
+        self.learner.opt_state = state["opt_state"]
 
     def stop(self):
         for r in self.runners:
