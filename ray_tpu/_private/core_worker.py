@@ -165,6 +165,7 @@ class _LeasePool:
             "job_id": self.core.job_id,
             "pg": opts.placement_group.id.binary() if opts.placement_group else None,
             "bundle_index": opts.placement_group_bundle_index,
+            "runtime_env": opts.runtime_env,
         }
         deadline = time.monotonic() + RAY_CONFIG.worker_start_timeout_s * 4
         while True:
@@ -224,6 +225,8 @@ class CoreWorker:
         self._in_store: Dict[ObjectID, bool] = {}
         self._tasks: Dict[TaskID, dict] = {}  # lineage / retry records
         self._lease_cache: Dict[tuple, List[dict]] = {}
+        self._renv_prepared: Dict[str, dict] = {}
+        self.job_runtime_env: Optional[dict] = None
         self._actors: Dict[ActorID, _ActorView] = {}
         self._actor_name_cache: Dict[ActorID, tuple] = {}
         self._pushed_functions: set = set()
@@ -283,7 +286,10 @@ class CoreWorker:
                 "entrypoint": " ".join(os.sys.argv[:2]),
             })))
             self.job_id = JobID(reply["job_id"])
-        await self.gcs.call("Subscribe", pickle.dumps({"channels": ["actors"]}))
+        channels = ["actors"]
+        if self.is_driver and getattr(self, "log_to_driver", False):
+            channels.append("logs")
+        await self.gcs.call("Subscribe", pickle.dumps({"channels": channels}))
         if self.raylet_address:
             self.raylet = RetryingRpcClient(self.raylet_address)
         else:
@@ -298,12 +304,22 @@ class CoreWorker:
 
     async def _on_gcs_reconnect(self, client):
         try:
-            await client.call("Subscribe", pickle.dumps({"channels": ["actors"]}))
+            channels = ["actors"]
+            if self.is_driver and getattr(self, "log_to_driver", False):
+                channels.append("logs")
+            await client.call("Subscribe", pickle.dumps({"channels": channels}))
         except Exception:
             pass
 
     def _on_push(self, channel: str, payload: bytes):
         msg = pickle.loads(payload)
+        if channel == "logs":
+            import sys as _sys
+
+            node = msg.get("node", "?")
+            for line in msg.get("lines", []):
+                print(f"\x1b[2m({node})\x1b[0m {line}", file=_sys.stderr)
+            return
         if channel == "actors":
             info = msg.get("info", {})
             aid = ActorID.from_hex(info["actor_id"])
@@ -344,6 +360,42 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # function / class table
     # ------------------------------------------------------------------
+
+    async def _prepare_runtime_env(self, renv):
+        """Normalize + upload runtime-env packages once (driver side;
+        reference: runtime_env/working_dir.py upload + uri_cache.py)."""
+        import json as _json
+
+        from ray_tpu._private import runtime_env as renv_mod
+
+        if renv is None:
+            renv = getattr(self, "job_runtime_env", None)
+        renv = renv_mod.normalize(renv)
+        if not renv:
+            return None
+        cache_key = _json.dumps(renv, sort_keys=True)
+        cached = self._renv_prepared.get(cache_key)
+        if cached is not None:
+            return cached
+        out = dict(renv)
+
+        async def upload(path):
+            if isinstance(path, dict):  # already a KV reference
+                return path
+            sha, blob, base = renv_mod.package_dir(path)
+            key = f"pkg:{sha}"
+            reply = await self._gcs_call("KVGet", {"ns": "renv", "key": key})
+            if reply["value"] is None:
+                await self._gcs_call("KVPut", {"ns": "renv", "key": key,
+                                               "value": blob})
+            return {"kv_key": key, "sha": sha, "base": base}
+
+        if "working_dir" in out:
+            out["working_dir"] = await upload(out["working_dir"])
+        if "py_modules" in out:
+            out["py_modules"] = [await upload(p) for p in out["py_modules"]]
+        self._renv_prepared[cache_key] = out
+        return out
 
     async def _push_function(self, obj) -> str:
         blob = cloudpickle.dumps(obj)
@@ -593,6 +645,7 @@ class CoreWorker:
         return refs[0] if opts.num_returns == 1 else refs
 
     async def _submit_task_async(self, remote_fn, args, kwargs, opts, task_id, refs):
+        opts.runtime_env = await self._prepare_runtime_env(opts.runtime_env)
         function_key = await self._push_function(remote_fn.function)
         args_blob = self._pack_args(args, kwargs)
         spec = TaskSpec(
@@ -685,9 +738,12 @@ class CoreWorker:
     # -- leases --
 
     async def _acquire_lease(self, opts: TaskOptions, resources):
+        from ray_tpu._private.runtime_env import env_hash
+
         key = (_freeze(resources), _freeze(opts.label_selector),
                opts.placement_group.id.binary() if opts.placement_group else None,
-               opts.placement_group_bundle_index)
+               opts.placement_group_bundle_index,
+               env_hash(opts.runtime_env))
         pool = self._lease_cache.get(key)
         if pool is None:
             pool = _LeasePool(self, key, opts, resources)
@@ -774,6 +830,7 @@ class CoreWorker:
                            opts.max_task_retries)
 
     async def _create_actor_async(self, actor_cls, args, kwargs, opts, actor_id):
+        opts.runtime_env = await self._prepare_runtime_env(opts.runtime_env)
         function_key = await self._push_function(actor_cls.cls)
         task_id = TaskID.of(self.job_id)
         spec = TaskSpec(
@@ -1192,5 +1249,6 @@ def connect_driver(address, num_cpus, num_tpus, resources, labels, namespace,
         namespace=namespace,
         node_supervisor=supervisor,
     )
+    worker.log_to_driver = bool(log_to_driver)
     worker.connect()
     return worker

@@ -459,6 +459,7 @@ class GcsServer:
                            if opts.placement_group is not None else None),
                     "bundle_index": opts.placement_group_bundle_index,
                     "for_actor": record.actor_id.binary(),
+                    "runtime_env": opts.runtime_env,
                 }), timeout=RAY_CONFIG.worker_start_timeout_s + 30))
                 if reply.get("status") != "granted":
                     await asyncio.sleep(0.2)

@@ -49,12 +49,13 @@ def _preexec():
 
 
 class WorkerProc:
-    def __init__(self, proc: subprocess.Popen):
+    def __init__(self, proc: subprocess.Popen, renv_hash: str = ""):
         self.proc = proc
         self.pid = proc.pid
         self.address = ""
         self.registered = asyncio.get_event_loop().create_future()
         self.job_hex: Optional[str] = None
+        self.renv_hash = renv_hash  # workers are dedicated to one runtime env
         self.leases: Set[str] = set()
         self.idle_since = time.monotonic()
         self.client: Optional[RetryingRpcClient] = None
@@ -117,6 +118,9 @@ class Raylet:
         await self.gcs.call("RegisterNode", pickle.dumps({"info": info}))
         self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._background.append(asyncio.ensure_future(self._monitor_workers_loop()))
+        if self.log_dir:
+            self._background.append(
+                asyncio.ensure_future(self._log_monitor_loop()))
         logger.info("raylet %s on %s resources=%s", self.node_id.hex()[:8], addr,
                     self.total_resources)
         return addr
@@ -166,7 +170,8 @@ class Raylet:
     # worker pool (reference: src/ray/raylet/worker_pool.h:276)
     # ------------------------------------------------------------------
 
-    def _spawn_worker(self) -> WorkerProc:
+    def _spawn_worker(self, renv: Optional[dict] = None,
+                      renv_hash: str = "") -> WorkerProc:
         cmd = [
             sys.executable, "-m", "ray_tpu._private.worker_main",
             "--raylet-address", self.server.address,
@@ -174,11 +179,20 @@ class Raylet:
             "--node-id", self.node_id.hex(),
             "--log-dir", self.log_dir,
         ]
+        env = self._spawn_env
+        if renv:
+            import base64 as _b64
+            import json as _json
+
+            cmd += ["--runtime-env",
+                    _b64.b64encode(_json.dumps(renv).encode()).decode()]
+            if renv.get("env_vars"):
+                env = dict(env, **renv["env_vars"])
         proc = subprocess.Popen(
-            cmd, env=self._spawn_env, preexec_fn=_preexec,
+            cmd, env=env, preexec_fn=_preexec,
             stdout=self._log_file("worker_stdout"), stderr=subprocess.STDOUT,
         )
-        w = WorkerProc(proc)
+        w = WorkerProc(proc, renv_hash)
         self.workers[w.pid] = w
         return w
 
@@ -188,13 +202,16 @@ class Raylet:
         os.makedirs(self.log_dir, exist_ok=True)
         return open(os.path.join(self.log_dir, f"{name}_{self.node_id.hex()[:8]}.log"), "ab")
 
-    async def _pop_worker(self, job_hex: Optional[str]) -> WorkerProc:
+    async def _pop_worker(self, job_hex: Optional[str],
+                          renv: Optional[dict] = None,
+                          renv_hash: str = "") -> WorkerProc:
         for i, w in enumerate(self.idle_workers):
-            if w.job_hex is None or w.job_hex == job_hex:
+            if (w.job_hex is None or w.job_hex == job_hex) \
+                    and w.renv_hash == renv_hash:
                 self.idle_workers.pop(i)
                 w.job_hex = w.job_hex or job_hex
                 return w
-        w = self._spawn_worker()
+        w = self._spawn_worker(renv, renv_hash)
         await asyncio.wait_for(w.registered, RAY_CONFIG.worker_start_timeout_s)
         w.job_hex = job_hex
         return w
@@ -211,6 +228,34 @@ class Raylet:
         if not w.registered.done():
             w.registered.set_result(True)
         return {"status": "ok", "node_id": self.node_id.hex()}
+
+    async def _log_monitor_loop(self):
+        """Tail this node's worker stdout and publish new lines to the GCS
+        "logs" channel so drivers can print remote worker output
+        (reference: _private/log_monitor.py:117)."""
+        path = os.path.join(
+            self.log_dir, f"worker_stdout_{self.node_id.hex()[:8]}.log")
+        pos = 0
+        node = self.node_id.hex()[:8]
+        while True:
+            await asyncio.sleep(0.5)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(pos)
+                    data = f.read()
+                    pos = f.tell()
+            except FileNotFoundError:
+                continue
+            if not data:
+                continue
+            lines = data.decode(errors="replace").splitlines()
+            try:
+                await self.gcs.call("Publish", pickle.dumps({
+                    "channel": "logs",
+                    "message": {"node": node, "lines": lines[:200]},
+                }), timeout=5.0, retries=0)
+            except Exception:
+                pass
 
     async def _monitor_workers_loop(self):
         while True:
@@ -250,9 +295,13 @@ class Raylet:
         return self.available
 
     async def _rpc_RequestWorkerLease(self, req, conn):
+        from ray_tpu._private.runtime_env import env_hash
+
         resources = req["resources"]
         pg = req.get("pg")
         bundle_index = req.get("bundle_index", -1)
+        renv = req.get("runtime_env")
+        renv_hash = env_hash(renv)
         job_hex = req["job_id"].hex() if req.get("job_id") is not None else None
         deadline = time.monotonic() + RAY_CONFIG.worker_start_timeout_s
         while True:
@@ -260,7 +309,7 @@ class Raylet:
             if resources_ge(pool, resources):
                 resources_sub(pool, resources)
                 try:
-                    w = await self._pop_worker(job_hex)
+                    w = await self._pop_worker(job_hex, renv, renv_hash)
                 except (asyncio.TimeoutError, Exception):
                     resources_add(pool, resources)
                     raise

@@ -1,0 +1,127 @@
+"""Runtime environments: per-task/actor env_vars, working_dir, py_modules.
+
+Reference: python/ray/_private/runtime_env/ — plugins install envs on the
+node before a worker runs the task (working_dir zips ship via GCS KV,
+uri_cache.py dedupes by content hash). TPU-first simplifications: no
+conda/pip installation (this image forbids installs; those keys raise), and
+the "agent" is folded into the worker pool — the raylet spawns workers with
+the runtime-env descriptor and the worker applies it before registering.
+
+Flow:
+- driver: ``prepare(core, renv)`` normalizes, zips local dirs, uploads each
+  package once to GCS KV (``renv_pkg:<sha1>``), and rewrites the descriptor
+  to reference the KV keys;
+- lease requests carry the descriptor; the worker pool keys idle workers by
+  (job, env-hash) so a worker only ever runs one runtime env;
+- worker: ``apply(renv, kv_get)`` sets env vars, downloads + extracts
+  packages to a node-local cache dir, prepends them to ``sys.path`` and
+  chdirs into the working_dir.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import sys
+import zipfile
+from typing import Any, Callable, Dict, Optional
+
+_PKG_NS = "renv"
+_CACHE_ROOT = "/tmp/ray_tpu_runtime_envs"
+_UNSUPPORTED = ("pip", "conda", "uv", "container", "image_uri", "java_jars")
+
+
+def normalize(renv: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if not renv:
+        return None
+    out: Dict[str, Any] = {}
+    for k, v in renv.items():
+        if k in _UNSUPPORTED:
+            raise ValueError(
+                f"runtime_env field {k!r} is not supported in this "
+                f"environment (package installation is disabled); use "
+                f"env_vars / working_dir / py_modules")
+        if k == "env_vars":
+            if not all(isinstance(a, str) and isinstance(b, str)
+                       for a, b in v.items()):
+                raise TypeError("env_vars must be Dict[str, str]")
+            out["env_vars"] = dict(v)
+        elif k in ("working_dir", "py_modules"):
+            out[k] = v
+        else:
+            raise ValueError(f"unknown runtime_env field {k!r}")
+    return out or None
+
+
+def env_hash(renv: Optional[Dict[str, Any]]) -> str:
+    if not renv:
+        return ""
+    return hashlib.sha1(
+        json.dumps(renv, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".venv")]
+            for f in files:
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, path))
+    return buf.getvalue()
+
+
+def package_dir(path: str) -> tuple:
+    """Zip a local dir for upload; returns (sha, blob, basename)."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"runtime_env directory not found: {path}")
+    blob = _zip_dir(path)
+    sha = hashlib.sha1(blob).hexdigest()[:16]
+    return sha, blob, os.path.basename(path) or "pkg"
+
+
+def _extract(pkg: Dict[str, str], kv_get: Callable[[str], Optional[bytes]]
+             ) -> str:
+    dest = os.path.join(_CACHE_ROOT, pkg["sha"])
+    marker = os.path.join(dest, ".ready")
+    if not os.path.exists(marker):
+        blob = kv_get(pkg["kv_key"])
+        if blob is None:
+            raise RuntimeError(
+                f"runtime_env package {pkg['kv_key']} missing from GCS KV")
+        tmp = dest + f".tmp.{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(blob)) as z:
+            z.extractall(tmp)
+        try:
+            os.rename(tmp, dest)
+        except OSError:  # another worker won the race
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+        with open(marker, "w") as f:
+            f.write("ok")
+    return dest
+
+
+def apply(renv: Optional[Dict[str, Any]],
+          kv_get: Callable[[str], Optional[bytes]]) -> None:
+    """Worker side: make the env effective for this process."""
+    if not renv:
+        return
+    for k, v in (renv.get("env_vars") or {}).items():
+        os.environ[k] = v
+    for pkg in renv.get("py_modules") or []:
+        path = _extract(pkg, kv_get)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    wd = renv.get("working_dir")
+    if wd:
+        path = _extract(wd, kv_get)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+        os.chdir(path)

@@ -244,6 +244,7 @@ def init(
     ignore_reinit_error: bool = False,
     object_store_memory: Optional[int] = None,
     log_to_driver: bool = True,
+    runtime_env: Optional[Dict[str, Any]] = None,
     _system_config: Optional[Dict[str, Any]] = None,
 ):
     """Start (or connect to) a cluster and attach this process as the driver.
@@ -256,12 +257,22 @@ def init(
             if ignore_reinit_error:
                 return _global_worker
             raise RuntimeError("ray_tpu.init() already called (use ignore_reinit_error=True)")
+        if address is None:
+            # submitted jobs find their cluster through the environment
+            # (reference: RAY_ADDRESS handling in ray.init)
+            import os
+
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
         if _system_config:
             import os
 
             for k, v in _system_config.items():
                 os.environ[f"RAY_TPU_{k.upper()}"] = str(v)
         if local_mode:
+            if runtime_env and runtime_env.get("env_vars"):
+                import os
+
+                os.environ.update(runtime_env["env_vars"])
             _global_worker = LocalWorker(namespace=namespace)
             return _global_worker
         from ray_tpu._private.core_worker import connect_driver
@@ -276,6 +287,12 @@ def init(
             object_store_memory=object_store_memory,
             log_to_driver=log_to_driver,
         )
+        if runtime_env:
+            from ray_tpu._private.runtime_env import normalize
+
+            # job-level default: merged into every task/actor whose options
+            # don't set their own runtime_env
+            _global_worker.job_runtime_env = normalize(runtime_env)
         return _global_worker
 
 

@@ -20,6 +20,8 @@ def main():
     parser.add_argument("--gcs-address", required=True)
     parser.add_argument("--node-id", required=True)
     parser.add_argument("--log-dir", default="")
+    parser.add_argument("--runtime-env", default="",
+                        help="base64 JSON runtime-env descriptor")
     args = parser.parse_args()
 
     from ray_tpu._private.logs import setup_process_logging
@@ -40,6 +42,20 @@ def main():
     core.current_actor_id = None
     core.connect()
     worker_mod._global_worker = core
+
+    if args.runtime_env:
+        import base64
+        import json
+
+        from ray_tpu._private import runtime_env as renv_mod
+
+        renv = json.loads(base64.b64decode(args.runtime_env))
+
+        def kv_get(key: str):
+            return core._run(core._gcs_call(
+                "KVGet", {"ns": "renv", "key": key}))["value"]
+
+        renv_mod.apply(renv, kv_get)
 
     import os
 

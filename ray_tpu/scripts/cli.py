@@ -70,6 +70,33 @@ def cmd_list(args):
     print(json.dumps(fn(), indent=2, default=str))
 
 
+def cmd_job(args):
+    """ray job submit/status/logs/list/stop (reference: job CLI in
+    dashboard/modules/job/cli.py)."""
+    _connect(args)
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    if args.job_command == "submit":
+        renv = json.loads(args.runtime_env) if args.runtime_env else None
+        sid = client.submit_job(entrypoint=" ".join(args.entrypoint),
+                                runtime_env=renv)
+        print(sid)
+        if args.wait:
+            status = client.wait_until_finished(sid, timeout=args.timeout)
+            print(status)
+            print(client.get_job_logs(sid), end="")
+            sys.exit(0 if status == "SUCCEEDED" else 1)
+    elif args.job_command == "status":
+        print(client.get_job_status(args.submission_id))
+    elif args.job_command == "logs":
+        print(client.get_job_logs(args.submission_id), end="")
+    elif args.job_command == "list":
+        print(json.dumps(client.list_jobs(), indent=2, default=str))
+    elif args.job_command == "stop":
+        print(client.stop_job(args.submission_id))
+
+
 def cmd_microbenchmark(args):
     import ray_tpu
 
@@ -102,6 +129,19 @@ def main(argv=None):
     p = sub.add_parser("list", help="list cluster entities")
     p.add_argument("what", choices=["nodes", "actors", "jobs", "placement-groups"])
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("job", help="submit and manage jobs")
+    jsub = p.add_subparsers(dest="job_command", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--runtime-env", default="", help="JSON runtime env")
+    js.add_argument("--wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=600.0)
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("submission_id")
+    jsub.add_parser("list")
+    p.set_defaults(fn=cmd_job)
 
     p = sub.add_parser("microbenchmark", help="run the core perf suite")
     p.add_argument("--duration", type=float, default=2.0)
