@@ -58,6 +58,9 @@ _d("rpc_call_timeout_s", 60.0)
 _d("rpc_retry_base_delay_ms", 50)
 _d("rpc_retry_max_delay_ms", 2000)
 _d("rpc_max_retries", 5)
+# ceiling on blind reconnect+retry of calls that provably never reached the
+# peer (safe for non-idempotent calls); keeps dead-peer detection fast
+_d("rpc_presend_retry_timeout_s", 15.0)
 # Chaos injection (reference: src/ray/rpc/rpc_chaos.h). Format:
 #   "Method=N" -> fail the first N calls of Method;
 #   "Method=N:p" -> after the first N, fail with probability p.

@@ -928,8 +928,14 @@ class CoreWorker:
                 # (a restarted actor's queue starts over at 1)
                 view.seqno += 1
                 spec.seqno = view.seqno
+                # short connect timeout + one blind reconnect: the address came
+                # from an ALIVE view, so an unreachable peer means the view is
+                # stale — fail fast into the GCS recheck below (the real retry
+                # loop) rather than camping on connect; the single presend
+                # round covers the connect-then-instant-RST race on live peers
                 reply = pickle.loads(await self._worker_client(view.address).call(
-                    "PushTask", pickle.dumps({"spec": spec}), timeout=86400.0, retries=0))
+                    "PushTask", pickle.dumps({"spec": spec}), timeout=86400.0,
+                    retries=0, connect_timeout=2.0, presend_retries=1))
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 view.state = "UNKNOWN"
                 await asyncio.sleep(0.2)

@@ -38,6 +38,11 @@ class RpcConnectionError(RpcError):
     pass
 
 
+class RpcNotConnectedError(RpcConnectionError):
+    """Raised before any bytes were sent — always safe to retry, even for
+    non-idempotent calls (the server never saw the request)."""
+
+
 class RpcApplicationError(RpcError):
     """Remote handler raised; message carries the remote traceback string."""
 
@@ -239,18 +244,25 @@ class RpcClient:
         deadline = time.monotonic() + timeout
         delay = RAY_CONFIG.rpc_retry_base_delay_ms / 1000.0
         last = None
-        while time.monotonic() < deadline:
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
             try:
-                self._reader, self._writer = await asyncio.open_connection(
-                    self._host, self._port
+                # bound each attempt too: a SYN blackhole (partitioned peer)
+                # must not camp for the kernel retry timeout
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(self._host, self._port), remaining
                 )
                 self._read_task = asyncio.ensure_future(self._read_loop())
                 return self
+            except asyncio.TimeoutError:
+                last = TimeoutError(f"connect attempt timed out after {remaining:.1f}s")
             except OSError as e:
                 last = e
-                await asyncio.sleep(delay)
+                await asyncio.sleep(min(delay, max(0.0, deadline - time.monotonic())))
                 delay = min(delay * 2, RAY_CONFIG.rpc_retry_max_delay_ms / 1000.0)
-        raise RpcConnectionError(f"cannot connect to {self.address}: {last}")
+        raise RpcNotConnectedError(f"cannot connect to {self.address}: {last}")
 
     @property
     def connected(self) -> bool:
@@ -290,7 +302,7 @@ class RpcClient:
     async def call(self, method: str, payload: bytes = b"", timeout: Optional[float] = None) -> bytes:
         await _maybe_chaos(self._chaos, method)
         if not self.connected:
-            raise RpcConnectionError(f"not connected to {self.address}")
+            raise RpcNotConnectedError(f"not connected to {self.address}")
         msg_id = next(self._msg_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[msg_id] = fut
@@ -331,26 +343,77 @@ class RetryingRpcClient:
         self._on_push = on_push
         self._on_reconnect = on_reconnect
         self._client: Optional[RpcClient] = None
+        self._connect_lock: Optional[asyncio.Lock] = None
 
-    async def _ensure(self) -> RpcClient:
-        if self._client is None or not self._client.connected:
-            self._client = RpcClient(self.address, on_push=self._on_push)
-            await self._client.connect()
-            if self._on_reconnect is not None:
-                res = self._on_reconnect(self._client)
-                if asyncio.iscoroutine(res):
-                    await res
+    async def _ensure(self, connect_timeout: Optional[float] = None) -> RpcClient:
+        if self._connect_lock is None:
+            self._connect_lock = asyncio.Lock()
+        async with self._connect_lock:
+            if self._client is None or not self._client.connected:
+                client = RpcClient(self.address, on_push=self._on_push)
+                try:
+                    await client.connect(timeout=connect_timeout)
+                    if self._on_reconnect is not None:
+                        res = self._on_reconnect(client)
+                        if asyncio.iscoroutine(res):
+                            await res
+                except BaseException:
+                    # don't cache a client whose post-connect setup (e.g. a
+                    # pubsub re-Subscribe) didn't finish — a cancelled
+                    # on_reconnect would otherwise be skipped forever
+                    await client.close()
+                    raise
+                self._client = client
         return self._client
 
     async def call(self, method: str, payload: bytes = b"", timeout: Optional[float] = None,
-                   retries: Optional[int] = None) -> bytes:
+                   retries: Optional[int] = None,
+                   connect_timeout: Optional[float] = None,
+                   presend_retries: Optional[int] = None) -> bytes:
         retries = RAY_CONFIG.rpc_max_retries if retries is None else retries
+        if presend_retries is None:
+            presend_retries = max(retries, 3)
         delay = RAY_CONFIG.rpc_retry_base_delay_ms / 1000.0
         attempt = 0
+        presend_attempt = 0
+        presend_deadline = None
+
+        async def _connected_client() -> RpcClient:
+            budget = connect_timeout
+            if presend_deadline is not None:
+                remaining = presend_deadline - time.monotonic()
+                budget = remaining if budget is None else min(budget, remaining)
+                if budget <= 0:
+                    raise RpcNotConnectedError(
+                        f"connect budget exhausted for {self.address}")
+            if budget is None:
+                return await self._ensure(None)
+            try:
+                # bound the whole ensure — including the wait on the shared
+                # connect lock — so one slow caller can't inflate another
+                # caller's fail-fast bound on the same cached client
+                return await asyncio.wait_for(self._ensure(budget), budget)
+            except asyncio.TimeoutError:
+                raise RpcNotConnectedError(f"connect to {self.address} timed out")
+
         while True:
             try:
-                client = await self._ensure()
+                client = await _connected_client()
                 return await client.call(method, payload, timeout)
+            except RpcNotConnectedError:
+                # nothing was sent (connect failed, or the connection dropped
+                # before the frame went out): reconnect and retry without
+                # consuming the caller's retry budget — non-idempotent calls
+                # stay safe. Deadline-bounded so a dead peer still fails fast.
+                if presend_deadline is None:
+                    presend_deadline = (
+                        time.monotonic() + RAY_CONFIG.rpc_presend_retry_timeout_s)
+                presend_attempt += 1
+                if presend_attempt > presend_retries \
+                        or time.monotonic() + delay >= presend_deadline:
+                    raise
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, RAY_CONFIG.rpc_retry_max_delay_ms / 1000.0)
             except (RpcConnectionError, asyncio.TimeoutError):
                 attempt += 1
                 if attempt > retries:
