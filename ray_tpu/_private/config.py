@@ -61,6 +61,9 @@ _d("rpc_max_retries", 5)
 # ceiling on blind reconnect+retry of calls that provably never reached the
 # peer (safe for non-idempotent calls); keeps dead-peer detection fast
 _d("rpc_presend_retry_timeout_s", 15.0)
+# after a GCS restart, how often to poll a replayed RUNNING job's driver
+# before declaring it gone and reaping the job's actors
+_d("gcs_driver_reattach_grace_s", 10.0)
 # Chaos injection (reference: src/ray/rpc/rpc_chaos.h). Format:
 #   "Method=N" -> fail the first N calls of Method;
 #   "Method=N:p" -> after the first N, fail with probability p.

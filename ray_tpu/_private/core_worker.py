@@ -309,7 +309,19 @@ class CoreWorker:
                 channels.append("logs")
             await client.call("Subscribe", pickle.dumps({"channels": channels}))
         except Exception:
-            pass
+            logger.warning("GCS reconnect: re-subscribe failed", exc_info=True)
+        if self.is_driver and not self.job_id.is_nil():
+            # re-bind this connection to our job after a GCS restart so
+            # driver-disconnect cleanup still fires (GCS FT)
+            for _ in range(3):
+                try:
+                    await client.call("ReattachDriver", pickle.dumps(
+                        {"job_id": self.job_id.binary()}))
+                    break
+                except Exception:
+                    logger.warning("GCS reconnect: ReattachDriver failed",
+                                   exc_info=True)
+                    await asyncio.sleep(0.2)
 
     def _on_push(self, channel: str, payload: bytes):
         msg = pickle.loads(payload)
@@ -1005,6 +1017,14 @@ class CoreWorker:
             return await self._handle_get_owned(pickle.loads(payload))
         if method == "Ping":
             return pickle.dumps({"status": "ok", "pid": os.getpid()})
+        if method == "CheckActor":
+            # GCS restart recovery probe: is the given actor instantiated
+            # here? (dedups in-flight creations after an init-data replay)
+            req = pickle.loads(payload)
+            hosting = (self.actor_instance is not None
+                       and self.actor_id is not None
+                       and self.actor_id.binary() == req["actor_id"])
+            return pickle.dumps({"hosting": hosting})
         if method == "Exit":
             self.loop.call_later(0.1, os._exit, 0)
             return pickle.dumps({"status": "ok"})

@@ -34,6 +34,7 @@ from ray_tpu._private.common import (
 from ray_tpu._private.config import RAY_CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.rpc import RpcError, RpcServer, RetryingRpcClient, ServerConnection
+from ray_tpu._private.store_client import make_store
 
 logger = logging.getLogger("ray_tpu.gcs")
 
@@ -55,6 +56,35 @@ class ActorRecord:
         self.death_cause = ""
         self.class_name = ""
         self.pending_kill = False
+        self.lease_id = ""
+
+    def dump(self) -> dict:
+        """Durable form for the store client (replayed on GCS restart)."""
+        return {
+            "spec": self.spec,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id.binary() if self.node_id else None,
+            "restarts_used": self.restarts_used,
+            "death_cause": self.death_cause,
+            "class_name": self.class_name,
+            "pending_kill": self.pending_kill,
+            "lease_id": self.lease_id,
+        }
+
+    @classmethod
+    def restore(cls, data: dict) -> "ActorRecord":
+        spec: TaskSpec = data["spec"]
+        record = cls(spec.actor_id, spec)
+        record.state = data["state"]
+        record.address = data["address"]
+        record.node_id = NodeID(data["node_id"]) if data["node_id"] else None
+        record.restarts_used = data["restarts_used"]
+        record.death_cause = data["death_cause"]
+        record.class_name = data["class_name"]
+        record.pending_kill = data["pending_kill"]
+        record.lease_id = data.get("lease_id", "")
+        return record
 
     def info(self) -> dict:
         return {
@@ -80,9 +110,26 @@ class PGRecord:
         self.bundle_nodes: List[Optional[NodeID]] = [None] * len(spec.bundles)
         self.ready_event = asyncio.Event()
 
+    def dump(self) -> dict:
+        return {
+            "spec": self.spec,
+            "state": self.state,
+            "bundle_nodes": [n.binary() if n else None for n in self.bundle_nodes],
+        }
+
+    @classmethod
+    def restore(cls, data: dict) -> "PGRecord":
+        pg = cls(data["spec"])
+        pg.state = data["state"]
+        pg.bundle_nodes = [NodeID(b) if b else None for b in data["bundle_nodes"]]
+        if pg.state in ("CREATED", "REMOVED"):
+            pg.ready_event.set()
+        return pg
+
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, persist_dir: str = ""):
+        self.store = make_store(persist_dir)
         self.server = RpcServer(self._handle, host, port)
         self.server.on_disconnect = self._on_disconnect
         self.nodes: Dict[NodeID, NodeInfo] = {}
@@ -101,10 +148,99 @@ class GcsServer:
         self._worker_clients: Dict[str, RetryingRpcClient] = {}
         self._background: List[asyncio.Task] = []
         self.start_time = time.time()
+        self._load_init_data()
+
+    # ------------------------------------------------------------------
+    # persistence (reference: gcs_init_data.cc replay + store_client/)
+    # ------------------------------------------------------------------
+
+    def _load_init_data(self):
+        """Reload all durable tables from the store (no-op for a fresh
+        in-memory store). Reference: GcsServer::Start loads GcsInitData
+        before DoStart (gcs_server.cc:212)."""
+        for key, blob in self.store.all("kv").items():
+            ns, _, k = key.partition("\x00")
+            self.kv[(ns, k)] = pickle.loads(blob)
+        for key, blob in self.store.all("nodes").items():
+            info: NodeInfo = pickle.loads(blob)
+            self.nodes[info.node_id] = info
+            if info.alive:
+                self.node_available[info.node_id] = dict(info.total_resources)
+                # grace period: raylets heartbeat in; health check reaps others
+                self.node_last_seen[info.node_id] = time.monotonic()
+                self.node_clients[info.node_id] = RetryingRpcClient(info.address)
+        for key, blob in self.store.all("actors").items():
+            record = ActorRecord.restore(pickle.loads(blob))
+            self.actors[record.actor_id] = record
+            if record.name and record.state != "DEAD":
+                self.named_actors[(record.namespace, record.name)] = record.actor_id
+        for key, blob in self.store.all("pgs").items():
+            pg = PGRecord.restore(pickle.loads(blob))
+            self.pgs[pg.spec.pg_id] = pg
+        for key, blob in self.store.all("jobs").items():
+            job = pickle.loads(blob)
+            self.jobs[JobID.from_hex(job["job_id"])] = job
+        counter = self.store.get("meta", "job_counter")
+        if counter is not None:
+            self.job_counter = pickle.loads(counter)
+        if self.actors or self.nodes:
+            logger.info(
+                "GCS init data replayed: %d nodes, %d actors, %d pgs, %d jobs, %d kv",
+                len(self.nodes), len(self.actors), len(self.pgs), len(self.jobs),
+                len(self.kv))
+
+    def _persist_kv(self, ns: str, key: str, value=None, delete: bool = False):
+        skey = f"{ns}\x00{key}"
+        if delete:
+            self.store.delete("kv", skey)
+        else:
+            self.store.put("kv", skey, pickle.dumps(value))
+
+    def _persist_node(self, info: NodeInfo):
+        if not info.alive:
+            self.store.delete("nodes", info.node_id.hex())
+        else:
+            self.store.put("nodes", info.node_id.hex(), pickle.dumps(info))
+
+    def _persist_actor(self, record: ActorRecord):
+        if record.state == "DEAD":
+            # terminal: delete rather than replay-forever (the in-memory
+            # record still serves info queries until the next restart)
+            self.store.delete("actors", record.actor_id.hex())
+        else:
+            self.store.put("actors", record.actor_id.hex(),
+                           pickle.dumps(record.dump()))
+
+    def _persist_pg(self, pg: PGRecord):
+        if pg.state == "REMOVED":
+            self.store.delete("pgs", pg.spec.pg_id.hex())
+        else:
+            self.store.put("pgs", pg.spec.pg_id.hex(), pickle.dumps(pg.dump()))
+
+    def _persist_job(self, job: dict):
+        if job["state"] == "FINISHED":
+            self.store.delete("jobs", job["job_id"])
+        else:
+            self.store.put("jobs", job["job_id"], pickle.dumps(job))
 
     async def start(self) -> str:
         addr = await self.server.start()
         self._background.append(asyncio.ensure_future(self._health_check_loop()))
+        # resume interrupted scheduling work from replayed init data
+        for record in self.actors.values():
+            if record.state in ("PENDING_CREATION", "RESTARTING"):
+                if record.address:
+                    # a creation was in flight when we died: probe before
+                    # rescheduling so we never run two instances
+                    asyncio.ensure_future(self._recover_creating_actor(record))
+                else:
+                    asyncio.ensure_future(self._schedule_actor(record))
+        for job_id, job in list(self.jobs.items()):
+            if job["state"] == "RUNNING":
+                asyncio.ensure_future(self._reap_job_if_driver_gone(job_id, job))
+        for pg in self.pgs.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                asyncio.ensure_future(self._schedule_pg(pg))
         logger.info("GCS listening on %s", addr)
         return addr
 
@@ -112,6 +248,7 @@ class GcsServer:
         for t in self._background:
             t.cancel()
         await self.server.stop()
+        self.store.close()
 
     # ------------------------------------------------------------------
     # dispatch
@@ -147,6 +284,7 @@ class GcsServer:
         self.node_available[info.node_id] = dict(info.total_resources)
         self.node_last_seen[info.node_id] = time.monotonic()
         self.node_clients[info.node_id] = RetryingRpcClient(info.address)
+        self._persist_node(info)
         logger.info("node %s registered: %s labels=%s", info.node_id.hex()[:8],
                     info.total_resources, info.labels)
         self._publish("nodes", {"event": "added", "node": info.to_dict()})
@@ -196,6 +334,7 @@ class GcsServer:
             return
         info.alive = False
         self.node_available.pop(node_id, None)
+        self._persist_node(info)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish("nodes", {"event": "removed", "node_id": node_id.hex(), "reason": reason})
         # drop object locations on that node
@@ -222,6 +361,7 @@ class GcsServer:
         if not req.get("overwrite", True) and key in self.kv:
             return {"added": False}
         self.kv[key] = req["value"]
+        self._persist_kv(key[0], key[1], req["value"])
         return {"added": True}
 
     async def _rpc_KVGet(self, req, conn):
@@ -234,8 +374,12 @@ class GcsServer:
             keys = [k for k in self.kv if k[0] == ns and k[1].startswith(req["key"])]
             for k in keys:
                 del self.kv[k]
+                self._persist_kv(k[0], k[1], delete=True)
             return {"deleted": len(keys)}
-        return {"deleted": 1 if self.kv.pop((ns, req["key"]), None) is not None else 0}
+        if self.kv.pop((ns, req["key"]), None) is not None:
+            self._persist_kv(ns, req["key"], delete=True)
+            return {"deleted": 1}
+        return {"deleted": 0}
 
     async def _rpc_KVKeys(self, req, conn):
         ns = req.get("ns", "")
@@ -258,7 +402,19 @@ class GcsServer:
             "entrypoint": req.get("entrypoint", ""),
         }
         self.conn_jobs[conn.conn_id] = job_id
+        self.store.put("meta", "job_counter", pickle.dumps(self.job_counter))
+        self._persist_job(self.jobs[job_id])
         return {"job_id": job_id.binary()}
+
+    async def _rpc_ReattachDriver(self, req, conn):
+        """A driver re-binds its (new) connection to its existing job after a
+        GCS restart, so driver-disconnect job cleanup keeps working."""
+        job_id = JobID(req["job_id"])
+        job = self.jobs.get(job_id)
+        if job is not None and job["state"] == "RUNNING":
+            self.conn_jobs[conn.conn_id] = job_id
+            return {"status": "ok"}
+        return {"status": "unknown_job"}
 
     async def _rpc_ListJobs(self, req, conn):
         return {"jobs": list(self.jobs.values())}
@@ -269,6 +425,7 @@ class GcsServer:
             return
         job["state"] = "FINISHED"
         job["end_time"] = time.time()
+        self._persist_job(job)
         logger.info("job %s finished; reaping its actors", job_id.hex())
         for record in list(self.actors.values()):
             if record.job_id == job_id and record.lifetime != "detached" and record.state != "DEAD":
@@ -408,6 +565,7 @@ class GcsServer:
         self.actors[actor_id] = record
         if record.name:
             self.named_actors[(record.namespace, record.name)] = actor_id
+        self._persist_actor(record)
         asyncio.ensure_future(self._schedule_actor(record))
         return {"status": "ok", "info": record.info()}
 
@@ -465,6 +623,13 @@ class GcsServer:
                     await asyncio.sleep(0.2)
                     continue
                 worker_addr = reply["worker_address"]
+                # durably note the in-flight creation BEFORE pushing it, so a
+                # GCS crash during creation can probe this worker instead of
+                # scheduling a second instance (see _recover_creating_actor)
+                record.address = worker_addr
+                record.node_id = node_id
+                record.lease_id = reply.get("lease_id", "")
+                self._persist_actor(record)
                 wreply = pickle.loads(await self._worker_client(worker_addr).call(
                     "PushTask", pickle.dumps({"spec": spec}), timeout=600.0))
                 if wreply.get("status") != "ok":
@@ -472,6 +637,8 @@ class GcsServer:
                                    record.actor_id.hex()[:8], worker_addr,
                                    wreply.get("error", "")[:500])
                     record.state = "DEAD"
+                    record.address = ""
+                    record.node_id = None
                     record.death_cause = wreply.get("error", "creation task failed")
                     self._publish_actor(record)
                     return
@@ -485,6 +652,64 @@ class GcsServer:
                                record.actor_id.hex()[:8], e)
                 await asyncio.sleep(0.3)
 
+    async def _recover_creating_actor(self, record: ActorRecord):
+        """After an init-data replay, a PENDING_CREATION/RESTARTING record
+        with an address means a creation push was in flight when we died.
+        Probe the worker: if the actor is instantiated there, adopt it as
+        ALIVE; otherwise release the orphaned lease and reschedule."""
+        addr = record.address
+        try:
+            reply = pickle.loads(await self._worker_client(addr).call(
+                "CheckActor", pickle.dumps({"actor_id": record.actor_id.binary()}),
+                timeout=10.0, retries=1, connect_timeout=2.0, presend_retries=1))
+            if reply.get("hosting"):
+                record.state = "ALIVE"
+                self._publish_actor(record)
+                logger.info("actor %s adopted on %s after GCS restart",
+                            record.actor_id.hex()[:8], addr)
+                return
+        except (RpcError, asyncio.TimeoutError, OSError):
+            pass
+        # not there: give the lease back (if the raylet is still up), then
+        # schedule from scratch
+        if record.lease_id and record.node_id in self.node_clients:
+            try:
+                await self.node_clients[record.node_id].call(
+                    "ReturnWorkerLease", pickle.dumps({"lease_id": record.lease_id}),
+                    timeout=5.0, retries=1)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
+        record.address = ""
+        record.node_id = None
+        record.lease_id = ""
+        self._persist_actor(record)
+        asyncio.ensure_future(self._schedule_actor(record))
+
+    async def _reap_job_if_driver_gone(self, job_id: JobID, job: dict):
+        """Replayed RUNNING jobs lost their connection binding when the GCS
+        died; poll the driver until it either reattaches (conn binding
+        restored) or turns out dead (job finished + actors reaped)."""
+        grace = RAY_CONFIG.gcs_driver_reattach_grace_s
+        while True:
+            await asyncio.sleep(grace)
+            if job_id not in self.jobs or self.jobs[job_id]["state"] != "RUNNING":
+                return
+            if any(j == job_id for j in self.conn_jobs.values()):
+                return  # driver reattached; disconnect cleanup is armed again
+            addr = job.get("driver_address", "")
+            if addr:
+                try:
+                    await self._worker_client(addr).call(
+                        "Ping", b"", timeout=5.0, retries=1,
+                        connect_timeout=3.0, presend_retries=1)
+                    continue  # driver alive but quiet; keep polling
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass
+            logger.warning("job %s driver gone after GCS restart; finishing it",
+                           job_id.hex())
+            await self._finish_job(job_id)
+            return
+
     def _pg_bundle_node(self, opts) -> Optional[NodeID]:
         pg_id = opts.placement_group.id
         pg = self.pgs.get(pg_id)
@@ -496,6 +721,7 @@ class GcsServer:
         return pg.bundle_nodes[idx]
 
     def _publish_actor(self, record: ActorRecord):
+        self._persist_actor(record)
         self._publish("actors", {"event": "state", "info": record.info()})
 
     async def _on_actor_worker_lost(self, record: ActorRecord, reason: str):
@@ -585,6 +811,7 @@ class GcsServer:
         spec: PlacementGroupSpec = req["spec"]
         pg = PGRecord(spec)
         self.pgs[spec.pg_id] = pg
+        self._persist_pg(pg)
         asyncio.ensure_future(self._schedule_pg(pg))
         return {"status": "ok"}
 
@@ -620,6 +847,7 @@ class GcsServer:
 
     async def _remove_pg(self, pg: PGRecord):
         pg.state = "REMOVED"
+        self._persist_pg(pg)
         for idx, node_id in enumerate(pg.bundle_nodes):
             if node_id is not None and node_id in self.node_clients:
                 try:
@@ -738,6 +966,7 @@ class GcsServer:
                     pass
             pg.bundle_nodes = list(plan)
             pg.state = "CREATED"
+            self._persist_pg(pg)
             pg.ready_event.set()
             self._publish("pgs", {"event": "created", "pg_id": pg.spec.pg_id.hex()})
             return
@@ -770,11 +999,13 @@ def main():
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--address-file", required=True)
     parser.add_argument("--log-dir", default="")
+    parser.add_argument("--persist-dir", default="",
+                        help="durable store directory enabling GCS fault tolerance")
     args = parser.parse_args()
     setup_process_logging("gcs", args.log_dir)
 
     async def run():
-        gcs = GcsServer(args.host, args.port)
+        gcs = GcsServer(args.host, args.port, persist_dir=args.persist_dir)
         addr = await gcs.start()
         tmp = args.address_file + ".tmp"
         with open(tmp, "w") as f:

@@ -62,6 +62,7 @@ class NodeSupervisor:
         resources: Optional[Dict[str, float]] = None,
         labels: Optional[Dict[str, str]] = None,
         object_store_memory: Optional[int] = None,
+        gcs_fault_tolerance: bool = False,
     ):
         self.resources = resources or {}
         self.labels = labels or {}
@@ -70,19 +71,47 @@ class NodeSupervisor:
         self.log_dir = os.path.join(self.session_dir, "logs")
         self.processes: List[subprocess.Popen] = []
         self.gcs_address: Optional[str] = None
+        self.gcs_fault_tolerance = gcs_fault_tolerance
+        self.gcs_persist_dir = (
+            os.path.join(self.session_dir, "gcs_store") if gcs_fault_tolerance else "")
+        self.gcs_proc: Optional[subprocess.Popen] = None
 
-    def start_head(self) -> str:
-        gcs_file = os.path.join(self.session_dir, "gcs_address")
-        gcs_proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.gcs",
-             "--address-file", gcs_file, "--log-dir", self.log_dir],
-            stdout=self._log("gcs_out"), stderr=subprocess.STDOUT,
+    def _launch_gcs(self, port: int = 0) -> str:
+        gcs_file = os.path.join(self.session_dir, f"gcs_address_{uuid.uuid4().hex[:6]}")
+        cmd = [sys.executable, "-m", "ray_tpu._private.gcs",
+               "--address-file", gcs_file, "--log-dir", self.log_dir]
+        if port:
+            cmd += ["--port", str(port)]
+        if self.gcs_persist_dir:
+            cmd += ["--persist-dir", self.gcs_persist_dir]
+        self.gcs_proc = subprocess.Popen(
+            cmd, stdout=self._log("gcs_out"), stderr=subprocess.STDOUT,
             preexec_fn=_preexec_die_with_parent,
         )
-        self.processes.append(gcs_proc)
-        self.gcs_address = _wait_for_file(gcs_file)
+        self.processes.append(self.gcs_proc)
+        return _wait_for_file(gcs_file)
+
+    def start_head(self) -> str:
+        self.gcs_address = self._launch_gcs()
         self.start_raylet(self.resources, self.labels, is_head=True)
         return self.gcs_address
+
+    def kill_gcs(self):
+        """Hard-kill the GCS process (fault-injection for FT tests)."""
+        assert self.gcs_proc is not None
+        self.gcs_proc.kill()
+        self.gcs_proc.wait(timeout=10)
+        self.processes.remove(self.gcs_proc)
+
+    def restart_gcs(self) -> str:
+        """Relaunch the GCS on the SAME address with the persisted tables
+        (reference: GCS FT via Redis-backed store + GcsInitData replay)."""
+        assert self.gcs_address and self.gcs_persist_dir, \
+            "restart_gcs requires gcs_fault_tolerance=True"
+        port = int(self.gcs_address.rsplit(":", 1)[1])
+        addr = self._launch_gcs(port=port)
+        assert addr == self.gcs_address, (addr, self.gcs_address)
+        return addr
 
     def start_raylet(self, resources=None, labels=None, is_head=False,
                      object_store_memory=None) -> str:

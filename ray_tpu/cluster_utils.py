@@ -45,6 +45,7 @@ class Cluster:
                 resources=head_args.get("resources", {"CPU": 2.0}),
                 labels=head_args.get("labels", {}),
                 object_store_memory=head_args.get("object_store_memory"),
+                gcs_fault_tolerance=head_args.get("gcs_fault_tolerance", False),
             )
             self.gcs_address = self.supervisor.start_head()
             self.nodes.append(ClusterNode(
@@ -104,6 +105,14 @@ class Cluster:
                     return
             time.sleep(0.1)
         raise TimeoutError(f"cluster did not reach {expect} nodes in {timeout}s")
+
+    def kill_gcs(self):
+        """Hard-kill the GCS process (requires gcs_fault_tolerance head arg)."""
+        self.supervisor.kill_gcs()
+
+    def restart_gcs(self) -> str:
+        """Restart the GCS on the same address; it replays persisted tables."""
+        return self.supervisor.restart_gcs()
 
     def shutdown(self):
         if self.supervisor is not None:
