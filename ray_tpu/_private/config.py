@@ -64,6 +64,9 @@ _d("rpc_presend_retry_timeout_s", 15.0)
 # after a GCS restart, how often to poll a replayed RUNNING job's driver
 # before declaring it gone and reaping the job's actors
 _d("gcs_driver_reattach_grace_s", 10.0)
+# unplaceable-demand entries older than this drop out of the autoscaler view
+# (live demand refreshes itself via scheduling retries)
+_d("autoscaler_demand_ttl_s", 15.0)
 # Chaos injection (reference: src/ray/rpc/rpc_chaos.h). Format:
 #   "Method=N" -> fail the first N calls of Method;
 #   "Method=N:p" -> after the first N, fail with probability p.

@@ -25,6 +25,7 @@ import pickle
 import threading
 import time
 import traceback
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -804,6 +805,9 @@ class CoreWorker:
                 req["spread_hint"] = self._spread_hint
         deadline = time.monotonic() + 300.0
         warned = False
+        # one demand unit per concurrent pick, stable across its retries, so
+        # the GCS autoscaler view counts waiters rather than poll attempts
+        req.setdefault("waiter_id", uuid.uuid4().hex)
         while True:
             reply = await self._gcs_call("PickNode", req)
             if reply["node"] is not None:

@@ -146,6 +146,11 @@ class GcsServer:
         self.subs: Dict[int, Tuple[ServerConnection, Set[str]]] = {}
         self.conn_jobs: Dict[int, JobID] = {}
         self._worker_clients: Dict[str, RetryingRpcClient] = {}
+        # unplaceable demand shapes -> autoscaler (reference: the v2
+        # gcs_autoscaler_state_manager.cc cluster-state view)
+        self.pending_demands: Dict[tuple, dict] = {}
+        self.node_last_used: Dict[NodeID, float] = {}
+        self.node_num_leases: Dict[NodeID, int] = {}
         self._background: List[asyncio.Task] = []
         self.start_time = time.time()
         self._load_init_data()
@@ -296,6 +301,9 @@ class GcsServer:
             return {"status": "unknown_node"}  # raylet should re-register
         self.node_last_seen[node_id] = time.monotonic()
         self.node_available[node_id] = req["available"]
+        self.node_num_leases[node_id] = req.get("num_leases", 0)
+        if self._node_used(node_id) or node_id not in self.node_last_used:
+            self.node_last_used[node_id] = time.monotonic()
         return {"status": "ok"}
 
     async def _rpc_GetAllNodes(self, req, conn):
@@ -494,7 +502,8 @@ class GcsServer:
                 out.append(node_id)
         return out
 
-    def _pick_node(self, resources: Dict[str, float], selector: Dict[str, str]) -> Optional[NodeID]:
+    def _pick_node(self, resources: Dict[str, float], selector: Dict[str, str],
+                   waiter_id: str = "") -> Optional[NodeID]:
         """Hybrid policy: pack onto the most-utilized feasible node below the
         spread threshold, else least-utilized (reference:
         raylet/scheduling/policy/hybrid_scheduling_policy.cc)."""
@@ -503,6 +512,7 @@ class GcsServer:
             # fall back to nodes that are feasible by total resources (queue there)
             feasible = self._feasible_nodes(resources, selector, check_available=False)
             if not feasible:
+                self._record_demand(resources, selector, waiter_id)
                 return None
         def utilization(nid):
             info = self.nodes[nid]
@@ -531,7 +541,8 @@ class GcsServer:
                 idx = req.get("spread_hint", 0) % len(feasible)
                 nid = sorted(feasible, key=lambda n: n.hex())[idx]
                 return {"node": self._node_addr(nid)}
-        nid = self._pick_node(req["resources"], req.get("selector", {}))
+        nid = self._pick_node(req["resources"], req.get("selector", {}),
+                              waiter_id=req.get("waiter_id", ""))
         return {"node": self._node_addr(nid) if nid else None}
 
     def _node_addr(self, nid: NodeID) -> dict:
@@ -591,7 +602,9 @@ class GcsServer:
                 if strat is not None and hasattr(strat, "node_id"):
                     node_id = NodeID.from_hex(strat.node_id)
                 else:
-                    node_id = self._pick_node(resources, selector)
+                    node_id = self._pick_node(
+                        resources, selector,
+                        waiter_id=record.actor_id.hex())
             if node_id is None or node_id not in self.nodes or not self.nodes[node_id].alive:
                 if not warned and time.monotonic() > deadline - 3590:
                     pass
@@ -928,6 +941,13 @@ class GcsServer:
         while pg.state in ("PENDING", "RESCHEDULING"):
             plan = self._plan_pg(pg)
             if plan is None:
+                # surface each bundle to the autoscaler (PACK/SPREAD gangs
+                # scale up via ordinary shape demand; STRICT_SPREAD is also
+                # exported whole so distinct-node needs are visible)
+                for idx, b in enumerate(pg.spec.bundles):
+                    self._record_demand(
+                        b.resources, b.label_selector,
+                        waiter_id=f"{pg.spec.pg_id.hex()}:{idx}")
                 await asyncio.sleep(0.5)
                 continue
             per_node: Dict[NodeID, List[int]] = {}
@@ -970,6 +990,79 @@ class GcsServer:
             pg.ready_event.set()
             self._publish("pgs", {"event": "created", "pg_id": pg.spec.pg_id.hex()})
             return
+
+    # ------------------------------------------------------------------
+    # autoscaler support (reference: gcs_autoscaler_state_manager.cc)
+    # ------------------------------------------------------------------
+
+    def _record_demand(self, resources: Dict[str, float], selector: Dict[str, str],
+                       waiter_id: str = ""):
+        """Count DISTINCT waiters per shape (a task retrying PickNode every
+        0.5s is one unit of demand, not one per retry)."""
+        now = time.monotonic()
+        key = (tuple(sorted(resources.items())), tuple(sorted(selector.items())))
+        entry = self.pending_demands.get(key)
+        if entry is None:
+            entry = self.pending_demands[key] = {
+                "shape": dict(resources), "selector": dict(selector),
+                "waiters": {}, "last_ts": now}
+        entry["waiters"][waiter_id or "_anon"] = now
+        entry["last_ts"] = now
+        self._prune_demands(now)
+
+    def _prune_demands(self, now: float):
+        ttl = RAY_CONFIG.autoscaler_demand_ttl_s
+        for key in [k for k, v in self.pending_demands.items()
+                    if now - v["last_ts"] > ttl]:
+            del self.pending_demands[key]
+        for v in self.pending_demands.values():
+            stale = [w for w, ts in v["waiters"].items() if now - ts > ttl]
+            for w in stale:
+                del v["waiters"][w]
+
+    def _node_used(self, node_id: NodeID) -> bool:
+        """A node is in use if any resource is claimed OR any lease is held
+        (zero-resource actors must not look idle to the autoscaler)."""
+        info = self.nodes.get(node_id)
+        if info is None:
+            return False
+        avail = self.node_available.get(node_id)
+        if avail is None:
+            return True  # no view yet: err on the busy side
+        if any(avail.get(k, 0.0) < v - 1e-9
+               for k, v in info.total_resources.items()):
+            return True
+        return self.node_num_leases.get(node_id, 0) > 0
+
+    async def _rpc_GetClusterStatus(self, req, conn):
+        """Everything the autoscaler reconciler needs in one poll: per-node
+        resources + idle info and the unplaceable-demand shapes."""
+        now = time.monotonic()
+        self._prune_demands(now)
+        nodes = []
+        for nid, info in self.nodes.items():
+            nodes.append({
+                "node_id": nid.hex(),
+                "alive": info.alive,
+                "is_head": info.is_head,
+                "labels": dict(info.labels),
+                "total": dict(info.total_resources),
+                "available": dict(self.node_available.get(nid, {})),
+                "used": self._node_used(nid),
+                "idle_s": now - self.node_last_used.get(nid, now),
+            })
+        demands = [
+            {"shape": v["shape"], "selector": v["selector"],
+             "count": min(len(v["waiters"]), 64)}
+            for v in self.pending_demands.values() if v["waiters"]
+        ]
+        strict_spread = [
+            [dict(b.resources) for b in pg.spec.bundles]
+            for pg in self.pgs.values()
+            if pg.state in ("PENDING", "RESCHEDULING")
+            and pg.spec.strategy == "STRICT_SPREAD"
+        ]
+        return {"nodes": nodes, "demands": demands, "strict_spread": strict_spread}
 
     # ------------------------------------------------------------------
     # debug / state api

@@ -154,6 +154,9 @@ class Raylet:
                 reply = pickle.loads(await self.gcs.call("Heartbeat", pickle.dumps({
                     "node_id": self.node_id,
                     "available": dict(self.available),
+                    # lease count keeps zero-resource actors visible to the
+                    # autoscaler's idle detection
+                    "num_leases": len(self.leases),
                 }), timeout=5.0, retries=0))
                 if reply.get("status") == "unknown_node":
                     info = NodeInfo(

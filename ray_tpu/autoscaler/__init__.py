@@ -1,0 +1,16 @@
+from ray_tpu.autoscaler.config import ClusterConfig, NodeTypeConfig
+from ray_tpu.autoscaler.autoscaler import Autoscaler
+from ray_tpu.autoscaler.node_provider import (
+    FakeMultiNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.sdk import request_resources
+
+__all__ = [
+    "Autoscaler",
+    "ClusterConfig",
+    "NodeTypeConfig",
+    "NodeProvider",
+    "FakeMultiNodeProvider",
+    "request_resources",
+]

@@ -1,0 +1,25 @@
+"""Programmatic autoscaler hints (reference:
+python/ray/autoscaler/sdk.py ``request_resources``)."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None):
+    """Ask the autoscaler to scale so these shapes could be placed
+    immediately (does not run anything). Persisted in the GCS KV and read
+    every reconcile round; overwrite with [] to clear."""
+    shapes: List[Dict[str, float]] = []
+    if num_cpus:
+        shapes.append({"CPU": float(num_cpus)})
+    if bundles:
+        shapes.extend({k: float(v) for k, v in b.items()} for b in bundles)
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    core._run(core._gcs_call("KVPut", {
+        "ns": "autoscaler", "key": "request_resources",
+        "value": pickle.dumps(shapes)}))
