@@ -1258,8 +1258,10 @@ class DriverWorker(CoreWorker):
 
 
 def connect_driver(address, num_cpus, num_tpus, resources, labels, namespace,
-                   object_store_memory, log_to_driver):
+                   object_store_memory, log_to_driver,
+                   include_dashboard=False, dashboard_port=None):
     supervisor = None
+    dashboard_address = ""
     if address is None:
         from ray_tpu._private.node import NodeSupervisor
 
@@ -1271,6 +1273,14 @@ def connect_driver(address, num_cpus, num_tpus, resources, labels, namespace,
         supervisor = NodeSupervisor(resources=node_res, labels=labels,
                                     object_store_memory=object_store_memory)
         address = supervisor.start_head()
+        if include_dashboard:
+            dashboard_address = supervisor.start_dashboard(port=dashboard_port)
+            logger.info("dashboard at http://%s", dashboard_address)
+    elif include_dashboard:
+        logger.warning(
+            "include_dashboard=True is ignored when connecting to an "
+            "existing cluster (%s); start one on the head node with "
+            "`ray-tpu start --include-dashboard` instead", address)
     worker = DriverWorker(
         gcs_address=address,
         raylet_address=None,
@@ -1279,6 +1289,7 @@ def connect_driver(address, num_cpus, num_tpus, resources, labels, namespace,
         namespace=namespace,
         node_supervisor=supervisor,
     )
+    worker.dashboard_address = dashboard_address
     worker.log_to_driver = bool(log_to_driver)
     worker.connect()
     return worker

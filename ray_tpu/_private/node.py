@@ -28,7 +28,9 @@ def _preexec_die_with_parent():
         pass
 
 
-def _wait_for_file(path: str, timeout: float = 30.0) -> str:
+def _wait_for_file(path: str, timeout: float = 30.0,
+                   proc: Optional[subprocess.Popen] = None,
+                   what: str = "service") -> str:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if os.path.exists(path):
@@ -36,8 +38,12 @@ def _wait_for_file(path: str, timeout: float = 30.0) -> str:
                 content = f.read().strip()
             if content:
                 return content
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"{what} exited with code {proc.returncode} before becoming "
+                f"ready (see its log under the session logs directory)")
         time.sleep(0.02)
-    raise TimeoutError(f"service did not write {path} in {timeout}s")
+    raise TimeoutError(f"{what} did not write {path} in {timeout}s")
 
 
 def new_session_dir() -> str:
@@ -95,6 +101,26 @@ class NodeSupervisor:
         self.gcs_address = self._launch_gcs()
         self.start_raylet(self.resources, self.labels, is_head=True)
         return self.gcs_address
+
+    def start_dashboard(self, host: str = "127.0.0.1",
+                        port: Optional[int] = None) -> str:
+        """Launch the dashboard-lite head HTTP server (reference:
+        dashboard/head.py started by services.py on the head node)."""
+        assert self.gcs_address
+        addr_file = os.path.join(self.session_dir, "dashboard_address")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.dashboard.head",
+             "--gcs-address", self.gcs_address,
+             "--host", host, "--port", str(port or 0),
+             "--log-dir", self.log_dir,
+             "--address-file", addr_file],
+            stdout=self._log("dashboard_out"), stderr=subprocess.STDOUT,
+            preexec_fn=_preexec_die_with_parent,
+        )
+        self.processes.append(proc)
+        self.dashboard_address = _wait_for_file(addr_file, proc=proc,
+                                                what="dashboard")
+        return self.dashboard_address
 
     def kill_gcs(self):
         """Hard-kill the GCS process (fault-injection for FT tests)."""

@@ -245,6 +245,8 @@ def init(
     object_store_memory: Optional[int] = None,
     log_to_driver: bool = True,
     runtime_env: Optional[Dict[str, Any]] = None,
+    include_dashboard: bool = False,
+    dashboard_port: Optional[int] = None,
     _system_config: Optional[Dict[str, Any]] = None,
 ):
     """Start (or connect to) a cluster and attach this process as the driver.
@@ -286,6 +288,8 @@ def init(
             namespace=namespace,
             object_store_memory=object_store_memory,
             log_to_driver=log_to_driver,
+            include_dashboard=include_dashboard,
+            dashboard_port=dashboard_port,
         )
         if runtime_env:
             from ray_tpu._private.runtime_env import normalize

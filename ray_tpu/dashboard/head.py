@@ -1,0 +1,351 @@
+"""Dashboard-lite: the head HTTP server.
+
+Reference: python/ray/dashboard/head.py (:49) + its modules — state
+(``modules/state``), jobs REST (``modules/job/job_head.py``), Prometheus
+metrics (``modules/metrics``), logs (``modules/log``). This build serves the
+same surfaces from one aiohttp app backed directly by the GCS (no React
+frontend; a minimal HTML status page instead).
+
+Endpoints:
+  GET  /                     - HTML cluster overview
+  GET  /api/version          - framework version
+  GET  /api/state            - full GCS state dump
+  GET  /api/nodes|actors|pgs - tables
+  GET  /api/cluster_status   - autoscaler view (demands, idle, per-node)
+  GET  /api/summary          - aggregate counts
+  GET  /metrics              - Prometheus text exposition
+  GET  /api/jobs             - submitted jobs (job manager KV)
+  POST /api/jobs             - {"entrypoint": ..., "runtime_env": ...}
+  GET  /api/jobs/{id}        - job info
+  GET  /api/jobs/{id}/logs   - job logs (text)
+  POST /api/jobs/{id}/stop   - stop a job
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import pickle
+import time
+from typing import Optional
+
+from ray_tpu._private.rpc import RetryingRpcClient
+
+_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body {{ font-family: monospace; margin: 2em; background: #111; color: #eee; }}
+ h1 {{ color: #7fd4ff; }} table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #444; padding: 4px 10px; text-align: left; }}
+ a {{ color: #7fd4ff; }}
+</style></head>
+<body>
+<h1>ray_tpu cluster</h1>
+<p>uptime {uptime:.0f}s &middot; {num_nodes} nodes &middot; {num_actors} actors
+&middot; {num_jobs} jobs</p>
+<h2>resources</h2><table>{resources}</table>
+<h2>nodes</h2><table><tr><th>node</th><th>alive</th><th>resources</th>
+<th>labels</th></tr>{nodes}</table>
+<h2>actors</h2><table><tr><th>actor</th><th>class</th><th>state</th>
+<th>name</th></tr>{actors}</table>
+<p><a href="/api/state">/api/state</a> &middot;
+<a href="/api/cluster_status">/api/cluster_status</a> &middot;
+<a href="/metrics">/metrics</a> &middot; <a href="/api/jobs">/api/jobs</a></p>
+</body></html>"""
+
+
+class DashboardHead:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1", port: int = 0):
+        self.gcs_address = gcs_address
+        self.host = host
+        self.port = port
+        self._gcs: Optional[RetryingRpcClient] = None
+        self._runner = None
+        self._site = None
+
+    # -- GCS I/O -------------------------------------------------------
+
+    async def _call(self, method: str, req: dict) -> dict:
+        if self._gcs is None:
+            self._gcs = RetryingRpcClient(self.gcs_address)
+        return pickle.loads(await self._gcs.call(method, pickle.dumps(req)))
+
+    # -- lifecycle -----------------------------------------------------
+
+    async def start(self) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.add_routes([
+            web.get("/", self._index),
+            web.get("/api/version", self._version),
+            web.get("/api/state", self._state),
+            web.get("/api/nodes", self._nodes),
+            web.get("/api/actors", self._actors),
+            web.get("/api/pgs", self._pgs),
+            web.get("/api/cluster_status", self._cluster_status),
+            web.get("/api/summary", self._summary),
+            web.get("/metrics", self._prometheus),
+            web.get("/api/jobs", self._jobs_list),
+            web.post("/api/jobs", self._jobs_submit),
+            web.get("/api/jobs/{id}", self._job_info),
+            web.get("/api/jobs/{id}/logs", self._job_logs),
+            web.post("/api/jobs/{id}/stop", self._job_stop),
+        ])
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+        self.port = self._site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        if self._runner:
+            await self._runner.cleanup()
+        if self._gcs:
+            await self._gcs.close()
+
+    # -- handlers ------------------------------------------------------
+
+    async def _version(self, request):
+        from aiohttp import web
+
+        import ray_tpu
+
+        return web.json_response({"version": getattr(ray_tpu, "__version__", "dev"),
+                                  "gcs_address": self.gcs_address})
+
+    async def _state(self, request):
+        from aiohttp import web
+
+        return web.json_response(await self._call("GetState", {}))
+
+    async def _nodes(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            (await self._call("GetAllNodes", {}))["nodes"])
+
+    async def _actors(self, request):
+        from aiohttp import web
+
+        return web.json_response(
+            (await self._call("ListActors", {}))["actors"])
+
+    async def _pgs(self, request):
+        from aiohttp import web
+
+        return web.json_response((await self._call("GetState", {}))["pgs"])
+
+    async def _cluster_status(self, request):
+        from aiohttp import web
+
+        return web.json_response(await self._call("GetClusterStatus", {}))
+
+    async def _summary(self, request):
+        from aiohttp import web
+
+        state = await self._call("GetState", {})
+        by_state: dict = {}
+        for a in state["actors"]:
+            by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+        return web.json_response({
+            "num_nodes": sum(1 for n in state["nodes"] if n["alive"]),
+            "num_actors": len(state["actors"]),
+            "actors_by_state": by_state,
+            "num_jobs": len(state["jobs"]),
+            "num_placement_groups": len(state["pgs"]),
+            "uptime_s": state.get("uptime_s", 0.0),
+        })
+
+    async def _index(self, request):
+        from aiohttp import web
+
+        state = await self._call("GetState", {})
+        res = await self._call("GetClusterResources", {})
+        rows_r = "".join(
+            f"<tr><td>{k}</td><td>{res['available'].get(k, 0):g} / {v:g}</td></tr>"
+            for k, v in sorted(res["total"].items()))
+        rows_n = "".join(
+            f"<tr><td>{n['node_id'][:12]}</td><td>{n['alive']}</td>"
+            f"<td>{n['total_resources']}</td><td>{n['labels']}</td></tr>"
+            for n in state["nodes"])
+        rows_a = "".join(
+            f"<tr><td>{a['actor_id'][:12]}</td><td>{a['class_name']}</td>"
+            f"<td>{a['state']}</td><td>{a['name']}</td></tr>"
+            for a in state["actors"][:200])
+        html = _HTML.format(
+            uptime=state.get("uptime_s", 0.0),
+            num_nodes=sum(1 for n in state["nodes"] if n["alive"]),
+            num_actors=len(state["actors"]),
+            num_jobs=len(state["jobs"]),
+            resources=rows_r, nodes=rows_n, actors=rows_a)
+        return web.Response(text=html, content_type="text/html")
+
+    # -- Prometheus ----------------------------------------------------
+
+    async def _prometheus(self, request):
+        from aiohttp import web
+
+        lines = []
+
+        def emit(name, value, labels=None, help_=None, kind="gauge"):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {kind}")
+            label_s = ""
+            if labels:
+                inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+                label_s = "{" + inner + "}"
+            lines.append(f"{name}{label_s} {value}")
+
+        state = await self._call("GetState", {})
+        res = await self._call("GetClusterResources", {})
+        emit("ray_tpu_cluster_nodes_alive",
+             sum(1 for n in state["nodes"] if n["alive"]),
+             help_="alive raylets", kind="gauge")
+        first = True
+        for k, v in sorted(res["total"].items()):
+            emit("ray_tpu_cluster_resource_total", v, {"resource": k},
+                 help_="total cluster resources" if first else None)
+            first = False
+        first = True
+        for k, v in sorted(res["available"].items()):
+            emit("ray_tpu_cluster_resource_available", v, {"resource": k},
+                 help_="available cluster resources" if first else None)
+            first = False
+        by_state: dict = {}
+        for a in state["actors"]:
+            by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+        first = True
+        for st, n in sorted(by_state.items()):
+            emit("ray_tpu_actors", n, {"state": st},
+                 help_="actors by state" if first else None)
+            first = False
+
+        # application metrics published by workers (util/metrics.py)
+        keys = (await self._call("KVKeys", {"ns": "metrics", "prefix": ""}))["keys"]
+        seen_names = set()
+        for key in keys:
+            blob = (await self._call("KVGet", {"ns": "metrics", "key": key}))["value"]
+            if blob is None:
+                continue
+            try:
+                payload = pickle.loads(blob)
+            except Exception:
+                continue
+            if time.time() - payload.get("time", 0) > 120:
+                continue  # stale process snapshot
+            for name, m in payload.get("metrics", {}).items():
+                prom = name.replace(".", "_").replace("-", "_")
+                if m["kind"] in ("counter", "gauge"):
+                    for tag_json, val in m["data"].items():
+                        labels = {**json.loads(tag_json), "pid": str(payload["pid"])}
+                        emit(prom, val, labels,
+                             help_=m.get("description") if prom not in seen_names else None,
+                             kind=m["kind"])
+                        seen_names.add(prom)
+                elif m["kind"] == "histogram":
+                    for tag_json, s in m["data"].get("sums", {}).items():
+                        labels = {**json.loads(tag_json), "pid": str(payload["pid"])}
+                        emit(prom + "_sum", s, labels)
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    # -- jobs ----------------------------------------------------------
+
+    async def _jobs_list(self, request):
+        from aiohttp import web
+
+        keys = (await self._call("KVKeys", {"ns": "job", "prefix": ""}))["keys"]
+        out = []
+        for k in keys:
+            blob = (await self._call("KVGet", {"ns": "job", "key": k}))["value"]
+            if blob is not None:
+                out.append(pickle.loads(blob))
+        return web.json_response(out)
+
+    async def _job_info(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["id"]
+        blob = (await self._call("KVGet", {"ns": "job", "key": sid}))["value"]
+        if blob is None:
+            return web.json_response({"error": f"no job {sid}"}, status=404)
+        return web.json_response(pickle.loads(blob))
+
+    async def _job_logs(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["id"]
+        blob = (await self._call("KVGet", {"ns": "job_logs", "key": sid}))["value"]
+        return web.Response(text=(blob or b"").decode(errors="replace"),
+                            content_type="text/plain")
+
+    async def _jobs_submit(self, request):
+        from aiohttp import web
+
+        body = await request.json()
+        if "entrypoint" not in body:
+            return web.json_response({"error": "entrypoint required"}, status=400)
+
+        def _submit():
+            from ray_tpu.job.job_manager import JobSubmissionClient
+
+            client = JobSubmissionClient(self.gcs_address)
+            return client.submit_job(
+                entrypoint=body["entrypoint"],
+                runtime_env=body.get("runtime_env"),
+                submission_id=body.get("submission_id"),
+                metadata=body.get("metadata"))
+
+        sid = await asyncio.get_event_loop().run_in_executor(None, _submit)
+        return web.json_response({"submission_id": sid})
+
+    async def _job_stop(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["id"]
+
+        def _stop():
+            from ray_tpu.job.job_manager import JobSubmissionClient
+
+            client = JobSubmissionClient(self.gcs_address)
+            return client.stop_job(sid)
+
+        ok = await asyncio.get_event_loop().run_in_executor(None, _stop)
+        return web.json_response({"stopped": bool(ok)})
+
+
+def main():
+    import argparse
+
+    from ray_tpu._private.logs import setup_process_logging
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8265)
+    parser.add_argument("--log-dir", default="")
+    parser.add_argument("--address-file", default="")
+    args = parser.parse_args()
+    setup_process_logging("dashboard", args.log_dir)
+
+    async def run():
+        head = DashboardHead(args.gcs_address, args.host, args.port)
+        port = await head.start()
+        if args.address_file:
+            import os
+
+            tmp = args.address_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(f"{args.host}:{port}")
+            os.replace(tmp, args.address_file)
+        print(f"dashboard listening on http://{args.host}:{port}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

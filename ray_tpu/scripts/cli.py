@@ -29,6 +29,9 @@ def cmd_start(args):
     with open(args.address_file, "w") as f:
         f.write(address)
     print(f"head started; GCS at {address} (address file: {args.address_file})")
+    if args.include_dashboard:
+        dash = supervisor.start_dashboard(port=args.dashboard_port)
+        print(f"dashboard at http://{dash}")
     print("press Ctrl-C to stop")
     try:
         signal.pause()
@@ -121,6 +124,8 @@ def main(argv=None):
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--resources", default="")
     p.add_argument("--labels", default="")
+    p.add_argument("--include-dashboard", action="store_true")
+    p.add_argument("--dashboard-port", type=int, default=8265)
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("status", help="cluster summary")

@@ -1,0 +1,95 @@
+"""Dashboard-lite tests (reference tier: dashboard REST + Prometheus +
+jobs endpoints, python/ray/dashboard/modules/*/tests)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def dash_cluster():
+    ray_tpu.shutdown()
+    worker = ray_tpu.init(num_cpus=4, include_dashboard=True)
+    address = worker.node_supervisor.dashboard_address
+    yield address
+    ray_tpu.shutdown()
+
+
+def _get(address, path, timeout=30):
+    with urllib.request.urlopen(f"http://{address}{path}", timeout=timeout) as r:
+        body = r.read().decode()
+        ctype = r.headers.get("Content-Type", "")
+    return body, ctype
+
+
+def _get_json(address, path):
+    body, _ = _get(address, path)
+    return json.loads(body)
+
+
+def test_state_endpoints(dash_cluster):
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return True
+
+    m = Marker.options(name="dash_marker", num_cpus=0.1).remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=60)
+
+    nodes = _get_json(dash_cluster, "/api/nodes")
+    assert len([n for n in nodes if n["alive"]]) == 1
+    actors = _get_json(dash_cluster, "/api/actors")
+    assert any(a["name"] == "dash_marker" for a in actors)
+    summary = _get_json(dash_cluster, "/api/summary")
+    assert summary["num_nodes"] == 1 and summary["num_actors"] >= 1
+    status = _get_json(dash_cluster, "/api/cluster_status")
+    assert status["nodes"] and "demands" in status
+
+
+def test_index_html(dash_cluster):
+    body, ctype = _get(dash_cluster, "/")
+    assert "text/html" in ctype
+    assert "ray_tpu cluster" in body
+
+
+def test_prometheus_metrics(dash_cluster):
+    from ray_tpu.util.metrics import Counter, publish_metrics
+
+    c = Counter("dash_test_total", description="test counter")
+    c.inc(3.0)
+    publish_metrics()
+
+    body, ctype = _get(dash_cluster, "/metrics")
+    assert "text/plain" in ctype
+    assert "ray_tpu_cluster_nodes_alive 1" in body
+    assert 'ray_tpu_cluster_resource_total{resource="CPU"} 4' in body
+    assert "dash_test_total" in body
+
+
+def test_jobs_rest_roundtrip(dash_cluster):
+    payload = json.dumps({
+        "entrypoint": "python -c \"print('dash job ran')\"",
+    }).encode()
+    req = urllib.request.Request(
+        f"http://{dash_cluster}/api/jobs", data=payload,
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        sid = json.loads(r.read())["submission_id"]
+
+    deadline = time.monotonic() + 120
+    status = None
+    while time.monotonic() < deadline:
+        info = _get_json(dash_cluster, f"/api/jobs/{sid}")
+        status = info["status"]
+        if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+            break
+        time.sleep(0.5)
+    assert status == "SUCCEEDED", f"job ended as {status}"
+    logs, _ = _get(dash_cluster, f"/api/jobs/{sid}/logs")
+    assert "dash job ran" in logs
+    jobs = _get_json(dash_cluster, "/api/jobs")
+    assert any(j["submission_id"] == sid for j in jobs)
