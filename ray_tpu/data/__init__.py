@@ -5,26 +5,36 @@ per-host TPU input pipelines via iter_batches / Train dataset sharding.
 """
 
 from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import (
     Dataset,
+    GroupedData,
+    from_blocks,
     from_items,
     from_numpy,
     from_pandas,
     range,
+    read_binary_files,
     read_csv,
     read_json,
     read_parquet,
+    read_text,
 )
 
 __all__ = [
     "Dataset",
+    "GroupedData",
+    "DataContext",
     "Block",
     "BlockAccessor",
     "range",
+    "from_blocks",
     "from_items",
     "from_pandas",
     "from_numpy",
     "read_parquet",
     "read_csv",
     "read_json",
+    "read_text",
+    "read_binary_files",
 ]

@@ -1,16 +1,13 @@
-"""Dataset: lazy logical plan -> distributed block execution.
+"""Dataset: lazy logical plan -> streaming physical execution.
 
-Reference: python/ray/data — ``Dataset`` (data/dataset.py) holding a logical
-plan executed by a streaming executor (_internal/execution/streaming_executor
-.py:66) as per-block tasks over object-store refs (RefBundle). Round-1
-architecture notes:
-
-- map-family ops chain per-block remote tasks WITHOUT barriers (each block
-  streams through the whole op chain; the object store backpressures via its
-  capacity + spill);
-- repartition / random_shuffle / split are barrier ops;
-- blocks live in the shared-memory object store; iteration pulls refs one at
-  a time so only a window of blocks is resident in the driver.
+Reference: python/ray/data — ``Dataset`` (data/dataset.py) holds a logical
+plan; consumption compiles it to a physical operator DAG executed by a
+streaming executor thread (_internal/execution/streaming_executor.py:66)
+with operator fusion (consecutive map-family stages fuse into one task per
+block), actor pools for class-UDFs, two-phase hash shuffles for
+sort/groupby/join/random_shuffle, bounded buffers for backpressure, and
+early-stop limits. Blocks live in the shared-memory object store and move
+as RefBundles; only small metadata reaches the driver.
 """
 
 from __future__ import annotations
@@ -18,230 +15,306 @@ from __future__ import annotations
 import builtins
 import functools
 import math
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import cloudpickle
 import numpy as np
 
 import ray_tpu
+from ray_tpu.data._internal.executor import Edge, StreamingExecutor
+from ray_tpu.data._internal.operators import (
+    ActorPoolMapOperator,
+    AllToAllOperator,
+    InputDataOperator,
+    LimitOperator,
+    PhysicalOperator,
+    ReadOperator,
+    RefBundle,
+    TaskPoolMapOperator,
+    UnionOperator,
+    WriteOperator,
+    ZipOperator,
+)
+from ray_tpu.data._internal import tasks as T
 from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+
+_MAP_KINDS = ("map_rows", "flat_map", "filter", "map_batches")
 
 
 # ---------------------------------------------------------------------------
-# remote block transforms (execute on workers)
+# logical ops
 # ---------------------------------------------------------------------------
 
 
-@ray_tpu.remote(num_cpus=1)
-def _produce_block(thunk_blob: bytes) -> Block:
-    thunk = cloudpickle.loads(thunk_blob)
-    return thunk()
+class _Op:
+    """Logical plan node. kind: read | input | map-family | all2all | limit |
+    union | zip | join | write."""
 
+    __slots__ = ("kind", "args")
 
-@ray_tpu.remote(num_cpus=1)
-def _apply_chain(chain_blob: bytes, block: Block) -> Block:
-    """Applies a list of (kind, fn) stages to one block."""
-    chain = cloudpickle.loads(chain_blob)
-    for kind, fn, batch_size in chain:
-        acc = BlockAccessor(block)
-        if kind == "map_rows":
-            block = BlockAccessor.build_from_rows([fn(r) for r in acc.to_rows()])
-        elif kind == "flat_map":
-            out: List[Any] = []
-            for r in acc.to_rows():
-                out.extend(fn(r))
-            block = BlockAccessor.build_from_rows(out)
-        elif kind == "filter":
-            block = BlockAccessor.build_from_rows(
-                [r for r in acc.to_rows() if fn(r)])
-        elif kind == "map_batches":
-            n = acc.num_rows()
-            bs = batch_size or n or 1
-            outs = []
-            for start in builtins.range(0, n, bs):
-                batch = BlockAccessor(acc.slice(start, min(start + bs, n))).to_batch()
-                result = fn(batch)
-                outs.append(BlockAccessor.build_from_batch(result)
-                            if isinstance(result, dict)
-                            else BlockAccessor.build_from_rows(list(result)))
-            rows: List[Any] = []
-            for b in outs:
-                rows.extend(BlockAccessor(b).to_rows())
-            block = BlockAccessor.build_from_rows(rows)
-        else:
-            raise ValueError(kind)
-    return block
-
-
-@ray_tpu.remote(num_cpus=1)
-def _merge_blocks(*blocks: Block) -> Block:
-    rows: List[Any] = []
-    for b in blocks:
-        rows.extend(BlockAccessor(b).to_rows())
-    return BlockAccessor.build_from_rows(rows)
-
-
-@ray_tpu.remote(num_cpus=1)
-def _slice_block(block: Block, start: int, end: int) -> Block:
-    return BlockAccessor(block).slice(start, end)
-
-
-@ray_tpu.remote(num_cpus=1)
-def _count_block(block: Block) -> int:
-    return BlockAccessor(block).num_rows()
-
-
-@ray_tpu.remote(num_cpus=1)
-def _write_parquet_block(block: Block, path: str, index: int) -> str:
-    import os
-
-    import pyarrow.parquet as pq
-
-    acc = BlockAccessor(block)
-    table = acc.block if acc._is_arrow() else None
-    if table is None:
-        import pyarrow as pa
-
-        table = pa.Table.from_pylist(acc.to_rows())
-    os.makedirs(path, exist_ok=True)
-    out = os.path.join(path, f"part-{index:05d}.parquet")
-    pq.write_table(table, out)
-    return out
-
-
-# ---------------------------------------------------------------------------
-# logical plan
-# ---------------------------------------------------------------------------
-
-
-@dataclass
-class _Plan:
-    # source thunks (each produces one block) OR upstream materialized refs
-    source_thunks: List[bytes] = field(default_factory=list)
-    source_refs: Optional[List[Any]] = None
-    chain: List[tuple] = field(default_factory=list)  # (kind, fn, batch_size)
-    barrier: Optional[tuple] = None  # applied after chain
-    parent: Optional["_Plan"] = None
+    def __init__(self, kind: str, **args):
+        self.kind = kind
+        self.args = args
 
 
 class Dataset:
-    def __init__(self, plan: _Plan):
-        self._plan = plan
-        self._materialized: Optional[List[Any]] = None
+    def __init__(self, ops: List[_Op]):
+        self._ops = ops
+        self._materialized: Optional[List[RefBundle]] = None
+        self._last_stats = ""
 
-    # -- transforms (lazy) --
+    # ------------------------------------------------------------------
+    # transforms (lazy)
+    # ------------------------------------------------------------------
 
-    def _extend(self, stage: tuple) -> "Dataset":
-        p = self._plan
-        newp = _Plan(source_thunks=p.source_thunks, source_refs=p.source_refs,
-                     chain=p.chain + [stage], barrier=p.barrier, parent=p.parent)
-        return Dataset(newp)
+    def _base_ops(self) -> List[_Op]:
+        """Plan prefix for derived datasets: a materialized parent is reused
+        as an input op so its reads/UDFs never re-execute."""
+        if self._materialized is not None:
+            return [_Op("input", bundles=list(self._materialized))]
+        return self._ops
 
-    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
-        return self._extend(("map_rows", fn, None))
+    def _extend(self, op: _Op) -> "Dataset":
+        return Dataset(self._base_ops() + [op])
 
-    def flat_map(self, fn: Callable[[Any], Iterable[Any]]) -> "Dataset":
-        return self._extend(("flat_map", fn, None))
+    def map(self, fn, *, num_cpus: Optional[float] = None,
+            concurrency: Optional[int] = None, **kw) -> "Dataset":
+        return self._map_family("map_rows", fn, None, num_cpus, concurrency, kw)
 
-    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
-        return self._extend(("filter", fn, None))
+    def flat_map(self, fn, *, num_cpus: Optional[float] = None,
+                 concurrency: Optional[int] = None, **kw) -> "Dataset":
+        return self._map_family("flat_map", fn, None, num_cpus, concurrency, kw)
 
-    def map_batches(self, fn: Callable[[Dict[str, np.ndarray]], Any],
-                    batch_size: Optional[int] = None, **_) -> "Dataset":
-        return self._extend(("map_batches", fn, batch_size))
+    def filter(self, fn, *, num_cpus: Optional[float] = None,
+               concurrency: Optional[int] = None, **kw) -> "Dataset":
+        return self._map_family("filter", fn, None, num_cpus, concurrency, kw)
 
-    # -- barriers --
+    def map_batches(self, fn, *, batch_size: Optional[int] = None,
+                    num_cpus: Optional[float] = None,
+                    concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    **kw) -> "Dataset":
+        return self._map_family("map_batches", fn, batch_size, num_cpus,
+                                concurrency,
+                                dict(kw, fn_constructor_args=fn_constructor_args,
+                                     fn_constructor_kwargs=fn_constructor_kwargs or {}))
+
+    def _map_family(self, kind, fn, batch_size, num_cpus, concurrency, kw):
+        is_class = isinstance(fn, type)
+        return self._extend(_Op(
+            "map", stage=kind, fn=fn, batch_size=batch_size,
+            num_cpus=num_cpus, concurrency=concurrency, is_class=is_class,
+            ctor_args=kw.get("fn_constructor_args", ()),
+            ctor_kwargs=kw.get("fn_constructor_kwargs", {})))
+
+    # -- all-to-all ----------------------------------------------------
 
     def repartition(self, num_blocks: int) -> "Dataset":
-        refs = self._execute()
-        rows_total = sum(ray_tpu.get([_count_block.remote(r) for r in refs],
-                                     timeout=600))
-        merged = _merge_blocks.remote(*refs) if len(refs) > 1 else refs[0]
-        per = max(1, math.ceil(rows_total / max(num_blocks, 1)))
-        new_refs = [
-            _slice_block.remote(merged, i * per, min((i + 1) * per, rows_total))
-            for i in builtins.range(num_blocks)
-            if i * per < rows_total or i == 0
-        ]
-        return Dataset(_Plan(source_refs=new_refs))
+        return self._extend(_Op("all2all", mode="repartition",
+                                num_partitions=num_blocks))
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        refs = self._execute()
-        nblocks = max(len(refs), 1)
+        return self._extend(_Op("all2all", mode="random_shuffle", seed=seed,
+                                num_partitions=None))
 
-        def _shuffle(block, seed=seed):
-            rows = BlockAccessor(block).to_rows()
-            rng = np.random.default_rng(seed)
-            perm = rng.permutation(len(rows))
-            return BlockAccessor.build_from_rows([rows[i] for i in perm])
+    def sort(self, key, descending: bool = False) -> "Dataset":
+        return self._extend(_Op("all2all", mode="sort", key=key,
+                                descending=descending, num_partitions=None))
 
-        merged = _merge_blocks.remote(*refs) if len(refs) > 1 else refs[0]
-        shuffled = _apply_chain.remote(
-            cloudpickle.dumps([("map_batches",
-                                lambda b, s=seed: _shuffle_batch(b, s), None)]),
-            merged)
-        ds = Dataset(_Plan(source_refs=[shuffled]))
-        return ds.repartition(nblocks) if nblocks > 1 else ds
+    def groupby(self, key) -> "GroupedData":
+        return GroupedData(self, key)
 
-    def union(self, other: "Dataset") -> "Dataset":
-        return Dataset(_Plan(source_refs=self._execute() + other._execute()))
+    def join(self, other: "Dataset", on: str, how: str = "inner",
+             num_partitions: Optional[int] = None,
+             suffix: str = "_right") -> "Dataset":
+        return Dataset(self._base_ops() + [
+            _Op("join", right=other, on=on, how=how,
+                num_partitions=num_partitions, suffix=suffix)])
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return Dataset(self._base_ops() + [_Op("union", others=list(others))])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return Dataset(self._base_ops() + [_Op("zip", right=other)])
 
     def limit(self, n: int) -> "Dataset":
-        rows = []
-        for row in self.iter_rows():
-            rows.append(row)
-            if len(rows) >= n:
-                break
-        return from_items(rows, parallelism=1)
+        return self._extend(_Op("limit", n=n))
 
-    def split(self, n: int) -> List["Dataset"]:
-        """Equal row-count splits (used by Train dataset sharding)."""
-        refs = self._execute()
-        counts = ray_tpu.get([_count_block.remote(r) for r in refs], timeout=600)
-        total = sum(counts)
-        per = total // n
-        merged = _merge_blocks.remote(*refs) if len(refs) > 1 else refs[0]
-        out = []
-        for i in builtins.range(n):
-            start = i * per
-            end = (i + 1) * per if i < n - 1 else total
-            out.append(Dataset(_Plan(source_refs=[
-                _slice_block.remote(merged, start, end)])))
-        return out
+    # ------------------------------------------------------------------
+    # compile logical -> physical
+    # ------------------------------------------------------------------
 
-    # -- execution --
+    def _compile(self, extra_op: Optional[_Op] = None
+                 ) -> Tuple[List[PhysicalOperator], List[Edge], PhysicalOperator]:
+        ctx = DataContext.get_current()
+        ops_logical = self._ops + ([extra_op] if extra_op else [])
+        phys: List[PhysicalOperator] = []
+        edges: List[Edge] = []
 
-    def _execute(self) -> List[Any]:
-        if self._materialized is not None:
-            return self._materialized
-        p = self._plan
-        if p.source_refs is not None:
-            refs = list(p.source_refs)
-        else:
-            refs = [_produce_block.remote(t) for t in p.source_thunks]
-        if p.chain:
-            blob = cloudpickle.dumps(p.chain)
-            refs = [_apply_chain.remote(blob, r) for r in refs]
-        self._materialized = refs
-        return refs
+        def link(src, dst, port="in"):
+            edges.append(Edge(src, dst, port))
+
+        def compile_into(logical: List[_Op], phys_out, edges_out):
+            """Returns the tail physical op of this chain."""
+            tail: Optional[PhysicalOperator] = None
+            pending_maps: List[_Op] = []
+
+            def flush_maps():
+                nonlocal tail
+                if not pending_maps:
+                    return
+                chain = []
+                ctors: Dict[str, tuple] = {}
+                any_class = False
+                num_cpus = ctx.num_cpus_per_task
+                concurrency = None
+                for i, m in enumerate(pending_maps):
+                    fn = m.args["fn"]
+                    if m.args["is_class"]:
+                        any_class = True
+                        name = f"udf_{i}"
+                        ctors[name] = (fn, m.args["ctor_args"], m.args["ctor_kwargs"])
+                        chain.append((m.args["stage"], name, m.args["batch_size"]))
+                    else:
+                        chain.append((m.args["stage"], fn, m.args["batch_size"]))
+                    if m.args["num_cpus"] is not None:
+                        num_cpus = m.args["num_cpus"]
+                    if m.args["concurrency"] is not None:
+                        concurrency = m.args["concurrency"]
+                label = "+".join(m.args["stage"] for m in pending_maps)
+                if any_class:
+                    op = ActorPoolMapOperator(
+                        f"ActorMap[{label}]", chain, ctors,
+                        pool_size=concurrency or 2, num_cpus=num_cpus)
+                else:
+                    op = TaskPoolMapOperator(
+                        f"Map[{label}]", chain, num_cpus=num_cpus,
+                        concurrency=concurrency)
+                phys_out.append(op)
+                if tail is not None:
+                    link(tail, op)
+                tail = op
+                pending_maps.clear()
+
+            for lop in logical:
+                if lop.kind == "read":
+                    op = ReadOperator(lop.args["thunks"],
+                                      num_cpus=ctx.num_cpus_per_task)
+                    phys_out.append(op)
+                    tail = op
+                elif lop.kind == "input":
+                    op = InputDataOperator(lop.args["bundles"])
+                    phys_out.append(op)
+                    tail = op
+                elif lop.kind == "map":
+                    pending_maps.append(lop)
+                elif lop.kind == "all2all":
+                    flush_maps()
+                    op = _build_all2all(lop, ctx)
+                    phys_out.append(op)
+                    link(tail, op)
+                    tail = op
+                elif lop.kind == "limit":
+                    flush_maps()
+                    op = LimitOperator(lop.args["n"])
+                    phys_out.append(op)
+                    if tail is not None:
+                        link(tail, op)
+                    tail = op
+                elif lop.kind == "union":
+                    flush_maps()
+                    op = UnionOperator()
+                    phys_out.append(op)
+                    link(tail, op)
+                    for other in lop.args["others"]:
+                        other_tail = compile_into(other._ops, phys_out, edges_out)
+                        link(other_tail, op)
+                    tail = op
+                elif lop.kind == "zip":
+                    flush_maps()
+                    op = ZipOperator()
+                    phys_out.append(op)
+                    link(tail, op, "left")
+                    right_tail = compile_into(lop.args["right"]._ops,
+                                              phys_out, edges_out)
+                    link(right_tail, op, "right")
+                    tail = op
+                elif lop.kind == "join":
+                    flush_maps()
+                    op = _JoinOperator(lop.args["on"], lop.args["how"],
+                                       lop.args["suffix"],
+                                       lop.args["num_partitions"],
+                                       num_cpus=ctx.num_cpus_per_task)
+                    phys_out.append(op)
+                    link(tail, op, "left")
+                    right_tail = compile_into(lop.args["right"]._ops,
+                                              phys_out, edges_out)
+                    link(right_tail, op, "right")
+                    tail = op
+                elif lop.kind == "write":
+                    flush_maps()
+                    op = WriteOperator(lop.args["write_fn"],
+                                       num_cpus=ctx.num_cpus_per_task)
+                    phys_out.append(op)
+                    link(tail, op)
+                    tail = op
+                else:
+                    raise ValueError(lop.kind)
+            flush_maps()
+            assert tail is not None, "empty dataset plan"
+            return tail
+
+        tail = compile_into(ops_logical, phys, edges)
+        return phys, edges, tail
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+
+    def _stream(self, extra_op: Optional[_Op] = None) -> Iterator[RefBundle]:
+        if self._materialized is not None and extra_op is None:
+            yield from self._materialized
+            return
+        phys, edges, tail = self._compile(extra_op)
+        executor = StreamingExecutor(phys, edges, tail).start()
+        try:
+            yield from executor.iter_output()
+            self._last_stats = executor.stats_summary()
+        finally:
+            executor.stop()
 
     def materialize(self) -> "Dataset":
-        self._execute()
+        if self._materialized is None:
+            self._materialized = list(self._stream())
         return self
 
-    # -- consumption --
+    def stats(self) -> str:
+        return self._last_stats
+
+    # ------------------------------------------------------------------
+    # consumption
+    # ------------------------------------------------------------------
 
     def iter_blocks(self) -> Iterator[Block]:
-        for ref in self._execute():
-            yield ray_tpu.get(ref, timeout=600)
+        for bundle in self._stream():
+            block = ray_tpu.get(bundle.block, timeout=600)
+            if bundle.rows is not None:
+                actual = BlockAccessor(block).num_rows()
+                if actual != bundle.rows:
+                    raise RuntimeError(
+                        f"object-plane consistency bug: block "
+                        f"{bundle.block.id.hex()} produced {bundle.rows} rows "
+                        f"but fetched {actual}")
+            yield block
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_blocks():
             yield from BlockAccessor(block).to_rows()
 
-    def iter_batches(self, batch_size: int = 256,
-                     drop_last: bool = False) -> Iterator[Dict[str, np.ndarray]]:
+    def iter_batches(self, batch_size: int = 256, drop_last: bool = False,
+                     prefetch_batches: int = 1) -> Iterator[Dict[str, np.ndarray]]:
         carry: List[Any] = []
         for block in self.iter_blocks():
             carry.extend(BlockAccessor(block).to_rows())
@@ -253,21 +326,23 @@ class Dataset:
 
     def take(self, n: int = 20) -> List[Any]:
         out: List[Any] = []
-        for row in self.iter_rows():
-            out.append(row)
+        for bundle in self.limit(n)._stream():
+            block = ray_tpu.get(bundle.block, timeout=600)
+            out.extend(BlockAccessor(block).to_rows())
             if len(out) >= n:
                 break
-        return out
+        return out[:n]
 
     def take_all(self) -> List[Any]:
         return list(self.iter_rows())
 
     def count(self) -> int:
-        refs = self._execute()
-        return sum(ray_tpu.get([_count_block.remote(r) for r in refs], timeout=600))
+        # row counts ride the meta stream; blocks are never fetched
+        return sum(b.rows or 0 for b in self._stream())
 
     def num_blocks(self) -> int:
-        return len(self._execute())
+        self.materialize()
+        return len(self._materialized)
 
     def schema(self):
         for block in self.iter_blocks():
@@ -289,21 +364,418 @@ class Dataset:
         frames = [BlockAccessor(b).to_pandas() for b in self.iter_blocks()]
         return pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
 
+    def split(self, n: int) -> List["Dataset"]:
+        """Equal row-count splits (used by Train dataset sharding)."""
+        self.materialize()
+        bundles = self._materialized
+        counts = [b.rows or 0 for b in bundles]
+        total = sum(counts)
+        per = total // n if n else 0
+        # build row-range views over the materialized blocks
+        out: List[Dataset] = []
+        starts = [i * per for i in builtins.range(n)]
+        ends = [(i + 1) * per if i < n - 1 else total for i in builtins.range(n)]
+        for s, e in builtins.zip(starts, ends):
+            refs: List[RefBundle] = []
+            pos = 0
+            for b, cnt in builtins.zip(bundles, counts):
+                lo, hi = max(s - pos, 0), min(e - pos, cnt)
+                if lo < hi:
+                    if lo == 0 and hi == cnt:
+                        refs.append(b)
+                    else:
+                        block_ref, meta_ref = T.slice_block.options(
+                            num_returns=2).remote(b.block, lo, hi)
+                        refs.append(RefBundle(block_ref, hi - lo, 0))
+                pos += cnt
+            ds = Dataset([_Op("input", bundles=refs)])
+            ds._materialized = refs
+            out.append(ds)
+        return out
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None):
+        ds: Dataset = self.random_shuffle(seed) if shuffle else self
+        ds.materialize()
+        total = ds.count()
+        n_test = int(total * test_size)
+        train, test = ds.split_at([total - n_test])
+        return train, test
+
+    def split_at(self, indices: List[int]) -> List["Dataset"]:
+        self.materialize()
+        bounds = [0] + list(indices) + [self.count()]
+        out = []
+        for s, e in builtins.zip(bounds[:-1], bounds[1:]):
+            sliced = self._slice_rows(s, e)
+            out.append(sliced)
+        return out
+
+    def _slice_rows(self, s: int, e: int) -> "Dataset":
+        bundles = self._materialized
+        refs: List[RefBundle] = []
+        pos = 0
+        for b in bundles:
+            cnt = b.rows or 0
+            lo, hi = max(s - pos, 0), min(e - pos, cnt)
+            if lo < hi:
+                if lo == 0 and hi == cnt:
+                    refs.append(b)
+                else:
+                    block_ref, _ = T.slice_block.options(
+                        num_returns=2).remote(b.block, lo, hi)
+                    refs.append(RefBundle(block_ref, hi - lo, 0))
+            pos += cnt
+        ds = Dataset([_Op("input", bundles=refs)])
+        ds._materialized = refs
+        return ds
+
+    # -- writes --------------------------------------------------------
+
+    def _write(self, write_fn) -> List[str]:
+        paths = []
+        for bundle in self._stream(_Op("write", write_fn=write_fn)):
+            block = ray_tpu.get(bundle.block, timeout=600)
+            paths.extend(r["path"] for r in BlockAccessor(block).to_rows())
+        return paths
+
     def write_parquet(self, path: str) -> List[str]:
-        refs = self._execute()
-        return ray_tpu.get([
-            _write_parquet_block.remote(r, path, i) for i, r in enumerate(refs)
-        ], timeout=600)
+        return self._write(functools.partial(_write_parquet_block, path))
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(functools.partial(_write_csv_block, path))
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(functools.partial(_write_json_block, path))
 
     def __repr__(self):
-        return f"Dataset(blocks={len(self._materialized) if self._materialized else '?'})"
+        n = len(self._materialized) if self._materialized else "?"
+        return f"Dataset(blocks={n}, ops={[o.kind for o in self._ops]})"
 
 
-def _shuffle_batch(batch: Dict[str, np.ndarray], seed) -> Dict[str, np.ndarray]:
-    rng = np.random.default_rng(seed)
-    n = len(next(iter(batch.values()))) if batch else 0
-    perm = rng.permutation(n)
-    return {k: np.asarray(v)[perm] for k, v in batch.items()}
+# ---------------------------------------------------------------------------
+# groupby / aggregates
+# ---------------------------------------------------------------------------
+
+
+class GroupedData:
+    """ds.groupby(key) -> aggregations over a hash shuffle (reference:
+    grouped_data.py riding operators/hash_shuffle.py)."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, spec: List[Tuple[str, Optional[str]]],
+             num_partitions: Optional[int] = None) -> Dataset:
+        key = self._key
+        return self._ds._extend(_Op(
+            "all2all", mode="groupby", key=key, agg_spec=spec,
+            num_partitions=num_partitions))
+
+    def count(self) -> Dataset:
+        return self._agg([("count", None)])
+
+    def sum(self, column: str) -> Dataset:
+        return self._agg([("sum", column)])
+
+    def mean(self, column: str) -> Dataset:
+        return self._agg([("mean", column)])
+
+    def min(self, column: str) -> Dataset:
+        return self._agg([("min", column)])
+
+    def max(self, column: str) -> Dataset:
+        return self._agg([("max", column)])
+
+    def std(self, column: str) -> Dataset:
+        return self._agg([("std", column)])
+
+    def aggregate(self, *specs: Tuple[str, Optional[str]]) -> Dataset:
+        return self._agg(list(specs))
+
+    def map_groups(self, fn) -> Dataset:
+        key = self._key
+        return self._ds._extend(_Op(
+            "all2all", mode="map_groups", key=key, fn=fn, num_partitions=None))
+
+
+
+def _stable_hash(value) -> int:
+    """Deterministic across processes (plain hash() is salted per process,
+    which would scatter equal keys across shuffle partitions)."""
+    import zlib
+
+    if isinstance(value, (int, np.integer)):
+        return int(value) & 0x7FFFFFFF
+    return zlib.crc32(repr(value).encode()) & 0x7FFFFFFF
+
+
+def _group_reduce(rows: List[dict], key, agg_spec):
+    groups: Dict[Any, List[dict]] = {}
+    keyfn = key if callable(key) else (lambda r: r[key])
+    for r in rows:
+        groups.setdefault(keyfn(r), []).append(r)
+    key_name = key if isinstance(key, str) else "key"
+    out = []
+    for k, members in sorted(groups.items(), key=lambda kv: repr(kv[0])):
+        row = {key_name: k}
+        for op, col in agg_spec:
+            vals = [m[col] for m in members] if col else None
+            if op == "count":
+                row["count()"] = len(members)
+            elif op == "sum":
+                s = sum(vals)
+                row[f"sum({col})"] = float(s) if isinstance(s, float) else s
+            elif op == "mean":
+                row[f"mean({col})"] = float(np.mean(vals))
+            elif op == "min":
+                row[f"min({col})"] = min(vals)  # works for any comparable
+            elif op == "max":
+                row[f"max({col})"] = max(vals)
+            elif op == "std":
+                row[f"std({col})"] = float(np.std(vals, ddof=1)) \
+                    if len(vals) > 1 else 0.0
+            else:
+                raise ValueError(op)
+        out.append(row)
+    return out
+
+
+def _map_groups_reduce(rows: List[dict], key, fn):
+    groups: Dict[Any, List[dict]] = {}
+    keyfn = key if callable(key) else (lambda r: r[key])
+    for r in rows:
+        groups.setdefault(keyfn(r), []).append(r)
+    out = []
+    for k in sorted(groups.keys(), key=repr):
+        res = fn(groups[k])
+        out.extend(res if isinstance(res, list) else [res])
+    return out
+
+
+def _build_all2all(lop: _Op, ctx: DataContext) -> AllToAllOperator:
+    mode = lop.args["mode"]
+    nparts = lop.args.get("num_partitions") or ctx.default_shuffle_partitions
+
+    if mode == "repartition":
+        def part_factory(_state):
+            # fresh per-process entropy (pickled closures would replay the
+            # same counter/rng state in every map task, skewing partitions)
+            def part(row, _c={}):
+                rng = _c.get("rng")
+                if rng is None:
+                    import random as _random
+
+                    rng = _c["rng"] = _random.Random()
+                return rng.randrange(1 << 30)
+            return part
+
+        return AllToAllOperator("Repartition", nparts, part_factory,
+                                lambda _s: (lambda rows: rows),
+                                num_cpus=ctx.num_cpus_per_task)
+
+    if mode == "random_shuffle":
+        seed = lop.args.get("seed")
+
+        def part_factory(_state, seed=seed):
+            if seed is not None:
+                # deterministic: partition by content hash mixed with seed
+                def part(row, seed=seed):
+                    return _stable_hash((seed, repr(row)))
+                return part
+
+            def part(row, _c={}):
+                rng = _c.get("rng")
+                if rng is None:
+                    import random as _random
+
+                    rng = _c["rng"] = _random.Random()
+                return rng.randrange(1 << 30)
+            return part
+
+        def reduce_factory(_state, seed=seed):
+            def red(rows, seed=seed):
+                rng = np.random.default_rng(seed)
+                perm = rng.permutation(len(rows))
+                return [rows[i] for i in perm]
+            return red
+
+        return AllToAllOperator("RandomShuffle", nparts, part_factory,
+                                reduce_factory, num_cpus=ctx.num_cpus_per_task)
+
+    if mode == "sort":
+        key = lop.args["key"]
+        descending = lop.args["descending"]
+        keyfn = key if callable(key) else (lambda r, k=key: r[k])
+
+        def prepare(bundles, n_parts, keyfn=keyfn):
+            return T.sample_boundaries.remote(
+                cloudpickle.dumps(keyfn), n_parts,
+                *[b.block for b in bundles])
+
+        def part_factory(boundaries, keyfn=keyfn):
+            import bisect
+
+            def part(row, b=boundaries, keyfn=keyfn):
+                return bisect.bisect_left(b, keyfn(row)) if b else 0
+            return part
+
+        def reduce_factory(_state, keyfn=keyfn, descending=descending):
+            def red(rows):
+                return sorted(rows, key=keyfn, reverse=descending)
+            return red
+
+        op = AllToAllOperator("Sort", nparts, part_factory, reduce_factory,
+                              prepare=prepare, num_cpus=ctx.num_cpus_per_task)
+        op.ordered = True
+        if descending:
+            op.reverse_order = True
+        return op
+
+    if mode == "groupby":
+        key = lop.args["key"]
+        spec = lop.args["agg_spec"]
+        keyfn = key if callable(key) else (lambda r, k=key: r[k])
+
+        def part_factory(_state, keyfn=keyfn):
+            def part(row, keyfn=keyfn):
+                return _stable_hash(keyfn(row))
+            return part
+
+        def reduce_factory(_state, key=key, spec=spec):
+            return functools.partial(_group_reduce, key=key, agg_spec=spec)
+
+        return AllToAllOperator("GroupBy", nparts, part_factory,
+                                reduce_factory, num_cpus=ctx.num_cpus_per_task)
+
+    if mode == "map_groups":
+        key = lop.args["key"]
+        fn = lop.args["fn"]
+        keyfn = key if callable(key) else (lambda r, k=key: r[k])
+
+        def part_factory(_state, keyfn=keyfn):
+            def part(row, keyfn=keyfn):
+                return _stable_hash(keyfn(row))
+            return part
+
+        def reduce_factory(_state, key=key, fn=fn):
+            return functools.partial(_map_groups_reduce, key=key, fn=fn)
+
+        return AllToAllOperator("MapGroups", nparts, part_factory,
+                                reduce_factory, num_cpus=ctx.num_cpus_per_task)
+
+    raise ValueError(mode)
+
+
+class _JoinOperator(AllToAllOperator):
+    """Two-sided barrier: hash-partition both inputs on the key, then join
+    each partition (reference: join via hash shuffle)."""
+
+    def __init__(self, on: str, how: str, suffix: str,
+                 num_partitions: Optional[int], num_cpus: float = 1.0):
+        def part_factory(_state, on=on):
+            def part(row, on=on):
+                return _stable_hash(row.get(on))
+            return part
+
+        super().__init__(f"Join[{how} on {on}]", num_partitions, part_factory,
+                         lambda _s: (lambda rows: rows), num_cpus=num_cpus)
+        self._join_blob = cloudpickle.dumps((on, how, suffix))
+        self._left_bundles: List[RefBundle] = []
+        self._right_bundles: List[RefBundle] = []
+        self._left_ids: set = set()
+        self._left_outputs: List = []
+        self._right_outputs: List = []
+        self._left_map_count = 0
+
+    def add_left(self, bundle: RefBundle):
+        self._left_bundles.append(bundle)
+        self._left_ids.add(id(bundle))
+        self._input_bundles.append(bundle)
+
+    def add_right(self, bundle: RefBundle):
+        self._right_bundles.append(bundle)
+
+    # left + right both shuffled with the same key partitioner
+    def _advance_phase(self):
+        if self._phase == "collect" and self.inputs_done:
+            self._input_bundles = self._left_bundles + self._right_bundles
+            self._start_map(None)
+
+    def _on_map_done(self, map_ref, bundle):
+        # maps finish in arbitrary order: split by side here so the reduce
+        # can tell left parts from right parts
+        if id(bundle) in self._left_ids:
+            self._left_outputs.append(map_ref)
+        else:
+            self._right_outputs.append(map_ref)
+
+    def _on_all_maps_done(self):
+        self._left_map_count = len(self._left_outputs)
+        self._map_outputs = self._left_outputs + self._right_outputs
+
+    def _n_parts(self) -> int:
+        if self._num_partitions:
+            return self._num_partitions
+        return max(1, len(self._left_bundles) + len(self._right_bundles))
+
+    def dispatch_one(self):
+        if self._map_pending:
+            return super().dispatch_one()
+        part_index = self._reduce_pending.popleft()
+        block_ref, meta_ref = T.join_reduce.options(
+            num_returns=2, num_cpus=self.num_cpus).remote(
+                self._join_blob, part_index, self._left_map_count,
+                *self._map_outputs)
+        self._active[meta_ref] = ("reduce", block_ref, part_index)
+        return [meta_ref]
+
+
+# ---------------------------------------------------------------------------
+# writers
+# ---------------------------------------------------------------------------
+
+
+def _write_parquet_block(path: str, block: Block, index: int) -> str:
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    acc = BlockAccessor(block)
+    table = acc.block if acc._is_arrow() else pa.Table.from_pylist(acc.to_rows())
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.parquet")
+    pq.write_table(table, out)
+    return out
+
+
+def _write_csv_block(path: str, block: Block, index: int) -> str:
+    import os
+
+    import pyarrow as pa
+    from pyarrow import csv as pacsv
+
+    acc = BlockAccessor(block)
+    table = acc.block if acc._is_arrow() else pa.Table.from_pylist(acc.to_rows())
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.csv")
+    pacsv.write_csv(table, out)
+    return out
+
+
+def _write_json_block(path: str, block: Block, index: int) -> str:
+    import json
+    import os
+
+    acc = BlockAccessor(block)
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.jsonl")
+    with open(out, "w") as f:
+        for row in acc.to_rows():
+            f.write(json.dumps(row, default=str) + "\n")
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -312,7 +784,15 @@ def _shuffle_batch(batch: Dict[str, np.ndarray], seed) -> Dict[str, np.ndarray]:
 
 
 def _make_dataset(thunks: List[Callable[[], Block]]) -> Dataset:
-    return Dataset(_Plan(source_thunks=[cloudpickle.dumps(t) for t in thunks]))
+    return Dataset([_Op("read", thunks=[cloudpickle.dumps(t) for t in thunks])])
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    bundles = [RefBundle(ray_tpu.put(b), BlockAccessor(b).num_rows(),
+                         BlockAccessor(b).size_bytes()) for b in blocks]
+    ds = Dataset([_Op("input", bundles=bundles)])
+    ds._materialized = bundles
+    return ds
 
 
 def range(n: int, parallelism: int = 8) -> Dataset:  # noqa: A001
@@ -357,8 +837,7 @@ def from_numpy(arr: np.ndarray) -> Dataset:
 
 def read_parquet(paths, parallelism: int = 8) -> Dataset:
     files = _expand_paths(paths, (".parquet",))
-    thunks = [functools.partial(_read_parquet_file, f) for f in files]
-    return _make_dataset(thunks)
+    return _make_dataset([functools.partial(_read_parquet_file, f) for f in files])
 
 
 def _read_parquet_file(path: str) -> Block:
@@ -369,8 +848,7 @@ def _read_parquet_file(path: str) -> Block:
 
 def read_csv(paths, parallelism: int = 8) -> Dataset:
     files = _expand_paths(paths, (".csv",))
-    thunks = [functools.partial(_read_csv_file, f) for f in files]
-    return _make_dataset(thunks)
+    return _make_dataset([functools.partial(_read_csv_file, f) for f in files])
 
 
 def _read_csv_file(path: str) -> Block:
@@ -381,14 +859,34 @@ def _read_csv_file(path: str) -> Block:
 
 def read_json(paths, parallelism: int = 8) -> Dataset:
     files = _expand_paths(paths, (".json", ".jsonl"))
-    thunks = [functools.partial(_read_json_file, f) for f in files]
-    return _make_dataset(thunks)
+    return _make_dataset([functools.partial(_read_json_file, f) for f in files])
 
 
 def _read_json_file(path: str) -> Block:
     from pyarrow import json as pajson
 
     return pajson.read_json(path)
+
+
+def read_text(paths, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths, (".txt", ".text", ".log", ""))
+    return _make_dataset([functools.partial(_read_text_file, f) for f in files])
+
+
+def _read_text_file(path: str) -> Block:
+    with open(path, "r", errors="replace") as f:
+        return BlockAccessor.build_from_rows(
+            [{"text": line.rstrip("\n")} for line in f])
+
+
+def read_binary_files(paths, parallelism: int = 8) -> Dataset:
+    files = _expand_paths(paths, ("",))
+    return _make_dataset([functools.partial(_read_binary_file, f) for f in files])
+
+
+def _read_binary_file(path: str) -> Block:
+    with open(path, "rb") as f:
+        return BlockAccessor.build_from_rows([{"path": path, "bytes": f.read()}])
 
 
 def _expand_paths(paths, suffixes) -> List[str]:
@@ -401,7 +899,7 @@ def _expand_paths(paths, suffixes) -> List[str]:
         if os.path.isdir(p):
             files.extend(
                 os.path.join(p, f) for f in sorted(os.listdir(p))
-                if f.endswith(suffixes))
+                if f.endswith(tuple(s for s in suffixes if s)) or "" in suffixes)
         else:
             files.append(p)
     if not files:
