@@ -133,6 +133,8 @@ class TaskSpec:
     actor_id: Optional[ActorID] = None
     method_name: str = ""
     seqno: int = -1
+    # device-object transport tag (reference: @ray.method(tensor_transport))
+    tensor_transport: str = ""
     # actor-creation fields
     is_actor_creation: bool = False
     actor_options: Optional[ActorOptions] = None

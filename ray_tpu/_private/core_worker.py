@@ -238,6 +238,10 @@ class CoreWorker:
         self._fn_cache: Dict[str, Any] = {}
         self.actor_instance = None
         self.actor_id: Optional[ActorID] = None
+        # device-object transport (reference: per-actor GPUObjectStore):
+        # values produced by tensor_transport-marked methods stay here
+        self.device_store: Dict[bytes, Any] = {}
+        self._device_fetch_cache: Dict[bytes, Any] = {}
         self._actor_async = False
         self._exec_pool = None
         self._exec_lock = threading.Lock()
@@ -563,6 +567,43 @@ class CoreWorker:
                 raise pickle.loads(reply["error"])
             # pending: loop
 
+    async def _maybe_pull_device(self, value, deadline):
+        """Resolve a DeviceObjectMarker by pulling from the holder worker
+        (zero-copy local hit when this worker IS the holder). Reference:
+        gpu_object_manager orchestrating p2p pulls between actors."""
+        from ray_tpu.experimental.device_objects import DeviceObjectMarker
+
+        if not isinstance(value, DeviceObjectMarker):
+            return value
+        if value.address == self.address:
+            if value.oid in self.device_store:
+                return self.device_store[value.oid]
+            raise ObjectLostError(
+                f"device object {value.oid.hex()[:12]} was freed")
+        cached = self._device_fetch_cache.get(value.oid)
+        if cached is not None:
+            return cached
+        timeout = max(1.0, min(deadline - time.monotonic(), 300.0))
+        try:
+            reply = pickle.loads(await self._worker_client(value.address).call(
+                "GetDeviceObject", pickle.dumps({"oid": value.oid}),
+                timeout=timeout, retries=1, connect_timeout=5.0))
+        except (RpcError, asyncio.TimeoutError) as e:
+            raise ObjectLostError(
+                f"holder {value.address} of device object "
+                f"{value.oid.hex()[:12]} unreachable: {e}")
+        if reply["status"] != "ok":
+            self._device_fetch_cache.pop(value.oid, None)
+            raise ObjectLostError(
+                f"device object {value.oid.hex()[:12]} gone from holder "
+                f"{value.address} (freed or actor restarted)")
+        inband, buffers = read_blob(reply["blob"])
+        fetched = deserialize(inband, buffers)
+        if len(self._device_fetch_cache) > 32:
+            self._device_fetch_cache.pop(next(iter(self._device_fetch_cache)))
+        self._device_fetch_cache[value.oid] = fetched
+        return fetched
+
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
         if single:
@@ -575,7 +616,7 @@ class CoreWorker:
                 value = await self._get_one(ref, deadline)
                 if isinstance(value, TaskError):
                     raise value
-                out.append(value)
+                out.append(await self._maybe_pull_device(value, deadline))
             return out
 
         values = self._run(_get_all())
@@ -622,23 +663,40 @@ class CoreWorker:
                 out.set_exception(e)
 
         def _schedule():
-            t = asyncio.ensure_future(
-                self._get_one(ref, time.monotonic() + 86400.0))
+            t = asyncio.ensure_future(self.await_ref(ref))
             t.add_done_callback(_done)
 
         self.loop.call_soon_threadsafe(_schedule)
         return out
 
     async def await_ref(self, ref):
-        value = await self._get_one(ref, time.monotonic() + 86400.0)
+        deadline = time.monotonic() + 86400.0
+        value = await self._get_one(ref, deadline)
         if isinstance(value, TaskError):
             raise value
-        return value
+        return await self._maybe_pull_device(value, deadline)
 
     def free_objects(self, refs: List[ObjectRef]):
+        from ray_tpu.experimental.device_objects import DeviceObjectMarker
+
         async def _free():
             oids = []
             for r in refs:
+                # a marker in the memory store points at a device-held value:
+                # release that too, or it would be orphaned forever
+                value = self.memory_store.get(r.id)
+                if isinstance(value, DeviceObjectMarker):
+                    self._device_fetch_cache.pop(value.oid, None)
+                    if value.address == self.address:
+                        self.device_store.pop(value.oid, None)
+                    else:
+                        try:
+                            await self._worker_client(value.address).call(
+                                "FreeDeviceObject",
+                                pickle.dumps({"oid": value.oid}),
+                                timeout=10.0, retries=1)
+                        except (RpcError, asyncio.TimeoutError, OSError):
+                            pass
                 self.memory_store.pop(r.id, None)
                 self._in_store.pop(r.id, None)
                 oids.append(r.binary())
@@ -882,16 +940,19 @@ class CoreWorker:
             asyncio.run_coroutine_threadsafe(_seed(), self.loop)
         return view
 
-    def submit_actor_task(self, handle, method_name, args, kwargs, num_returns=1):
+    def submit_actor_task(self, handle, method_name, args, kwargs, num_returns=1,
+                          tensor_transport=""):
         task_id = TaskID.of(self.job_id)
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
                 for i in range(num_returns)]
         self._run(self._submit_actor_task_async(
-            handle, method_name, args, kwargs, num_returns, task_id, refs))
+            handle, method_name, args, kwargs, num_returns, task_id, refs,
+            tensor_transport))
         return refs[0] if num_returns == 1 else refs
 
     async def _submit_actor_task_async(self, handle, method_name, args, kwargs,
-                                       num_returns, task_id, refs):
+                                       num_returns, task_id, refs,
+                                       tensor_transport=""):
         view = self._actor_view(handle.actor_id)
         spec = TaskSpec(
             task_id=task_id,
@@ -903,6 +964,7 @@ class CoreWorker:
             owner_address=self.address,
             actor_id=handle.actor_id,
             method_name=method_name,
+            tensor_transport=tensor_transport,
         )
         record = {"spec": spec, "attempts": 0,
                   "max_retries": handle._max_task_retries,
@@ -1021,6 +1083,21 @@ class CoreWorker:
             return await self._handle_get_owned(pickle.loads(payload))
         if method == "Ping":
             return pickle.dumps({"status": "ok", "pid": os.getpid()})
+        if method == "GetDeviceObject":
+            req = pickle.loads(payload)
+            value = self.device_store.get(req["oid"])
+            if value is None and req["oid"] not in self.device_store:
+                return pickle.dumps({"status": "gone"})
+            # large device->host copies must not stall the event loop
+            self._ensure_pool(1)
+            inband, buffers = await self.loop.run_in_executor(
+                self._exec_pool, serialize, value)
+            return pickle.dumps({"status": "ok",
+                                 "blob": pack_blob(inband, buffers)})
+        if method == "FreeDeviceObject":
+            req = pickle.loads(payload)
+            freed = self.device_store.pop(req["oid"], None) is not None
+            return pickle.dumps({"freed": freed})
         if method == "CheckActor":
             # GCS restart recovery probe: is the given actor instantiated
             # here? (dedups in-flight creations after an init-data replay)
@@ -1104,14 +1181,16 @@ class CoreWorker:
                 value = await self._get_one(v, time.monotonic() + RAY_CONFIG.object_pull_timeout_s)
                 if isinstance(value, TaskError):
                     raise value
-                return value
+                return await self._maybe_pull_device(
+                    value, time.monotonic() + RAY_CONFIG.object_pull_timeout_s)
             return v
 
         args = [await _resolve(a) for a in args]
         kwargs = {k: await _resolve(v) for k, v in kwargs.items()}
         return args, kwargs
 
-    async def _pack_results(self, spec: TaskSpec, result, err) -> bytes:
+    async def _pack_results(self, spec: TaskSpec, result, err,
+                            transport: str = "") -> bytes:
         if err is not None:
             return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
         values: List[Any]
@@ -1129,6 +1208,12 @@ class CoreWorker:
         results = []
         for i, value in enumerate(values):
             oid = ObjectID.for_task_return(spec.task_id, i)
+            if transport:
+                # the value stays resident here; ship a small marker instead
+                from ray_tpu.experimental.device_objects import DeviceObjectMarker
+
+                self.device_store[oid.binary()] = value
+                value = DeviceObjectMarker(oid.binary(), self.address, transport)
             inband, buffers = serialize(value)
             total = len(inband) + sum(b.nbytes for b in buffers)
             if total < RAY_CONFIG.object_inline_max_bytes:
@@ -1190,6 +1275,12 @@ class CoreWorker:
         if method is None:
             err = TaskError(f"AttributeError: no method {spec.method_name}", "")
             return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
+        # per-call options win over the decorator; "object" forces the
+        # plain object-plane return (reference: ray.method override order)
+        transport = (getattr(spec, "tensor_transport", "")
+                     or getattr(method, "__ray_tpu_tensor_transport__", ""))
+        if transport == "object":
+            transport = ""
         args, kwargs = await self._resolve_args(spec.args_blob)
         if asyncio.iscoroutinefunction(method):
             async with self._actor_sem:
@@ -1200,7 +1291,7 @@ class CoreWorker:
         else:
             result, err = await self.loop.run_in_executor(
                 self._exec_pool, self._call_user_fn, method, args, kwargs, spec)
-        return await self._pack_results(spec, result, err)
+        return await self._pack_results(spec, result, err, transport=transport)
 
     # ------------------------------------------------------------------
     # shutdown

@@ -161,7 +161,10 @@ class LocalWorker:
             self._actor_meta[actor_id] = key
         return ActorHandle(actor_id, _instance_methods(instance), actor_cls.class_name)
 
-    def submit_actor_task(self, handle, method_name, args, kwargs, num_returns=1):
+    def submit_actor_task(self, handle, method_name, args, kwargs, num_returns=1,
+                          tensor_transport=""):
+        # local mode runs in-process: values are already "device-resident",
+        # so the transport tag is a no-op
         if handle.actor_id not in self._actors:
             raise ActorDiedError(f"actor {handle.actor_id.hex()} is dead")
         instance = self._actors[handle.actor_id]

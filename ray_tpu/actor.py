@@ -47,6 +47,7 @@ class ActorMethod:
         return _worker.global_worker().submit_actor_task(
             self._handle, self._method_name, args, kwargs,
             num_returns=overrides.get("num_returns", self._num_returns),
+            tensor_transport=overrides.get("tensor_transport", ""),
         )
 
     def bind(self, *args, **kwargs):

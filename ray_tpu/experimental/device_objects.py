@@ -1,0 +1,68 @@
+"""Device object transport: tensors stay where they were produced.
+
+Reference: python/ray/experimental/gpu_object_manager (``@ray.method(
+tensor_transport=...)``, per-actor GPUObjectStore, driver-side orchestration
+of p2p pulls) — re-architected for TPU: the value returned by a marked actor
+method stays in the producing worker (device memory for jax arrays), and a
+small ``DeviceObjectMarker`` travels through the object plane instead.
+Consumers (other actors, or the driver) pull the value directly from the
+holder worker — the driver never relays tensor bytes between actors. On a
+real slice the pull lowers to host-mediated transfer today; the marker
+carries the transport tag so an ICI path can slot in without API change.
+
+Usage::
+
+    class Producer:
+        @ray_tpu.method(tensor_transport="device")
+        def weights(self):
+            return jnp.ones((4096, 4096))
+
+    ref = producer.weights.remote()     # returns instantly; value stays put
+    consumer.consume.remote(ref)        # consumer pulls p2p from producer
+    ray_tpu.get(ref)                    # driver pulls from producer
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class DeviceObjectMarker:
+    """Placeholder for a value held in a producer worker's device store."""
+
+    __slots__ = ("oid", "address", "transport")
+
+    def __init__(self, oid: bytes, address: str, transport: str = "device"):
+        self.oid = oid
+        self.address = address
+        self.transport = transport
+
+    def __reduce__(self):
+        return (DeviceObjectMarker, (self.oid, self.address, self.transport))
+
+    def __repr__(self):
+        return (f"DeviceObjectMarker({self.oid.hex()[:12]} @ {self.address}, "
+                f"{self.transport})")
+
+
+def free(ref) -> bool:
+    """Release the device-held value behind ``ref`` on its holder worker.
+    Returns False if the value was already gone."""
+    import pickle
+    import time
+
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    # resolve the MARKER itself (core.get would pull the tensor)
+    marker = core._run(core._get_one(ref, time.monotonic() + 60.0))
+    if not isinstance(marker, DeviceObjectMarker):
+        raise TypeError("free() expects a ref produced by a "
+                        "tensor_transport-marked method")
+
+    async def _free():
+        reply = await core._worker_client(marker.address).call(
+            "FreeDeviceObject", pickle.dumps({"oid": marker.oid}), timeout=30.0)
+        return pickle.loads(reply)["freed"]
+
+    return core._run(_free())
