@@ -10,6 +10,7 @@ dynamic batching inside replicas (serve/batching.py).
 
 from ray_tpu.serve.api import (
     Application,
+    AutoscalingConfig,
     Deployment,
     DeploymentHandle,
     batch,
@@ -21,9 +22,13 @@ from ray_tpu.serve.api import (
     start_http_proxy,
     status,
 )
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment",
+    "AutoscalingConfig",
+    "multiplexed",
+    "get_multiplexed_model_id",
     "Deployment",
     "Application",
     "DeploymentHandle",

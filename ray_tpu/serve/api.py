@@ -35,11 +35,30 @@ CONTROLLER_NAME = "serve_controller"
 
 
 @dataclass
+class AutoscalingConfig:
+    """Reference: serve/autoscaling_policy.py + config.AutoscalingConfig."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AutoscalingConfig":
+        unknown = set(d) - set(cls.__dataclass_fields__)
+        if unknown:
+            raise ValueError(f"unknown autoscaling_config keys: {sorted(unknown)}")
+        return cls(**d)
+
+
+@dataclass
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 16
     ray_actor_options: Dict[str, Any] = field(default_factory=lambda: {"num_cpus": 1.0})
     health_check_period_s: float = 2.0
+    autoscaling: Optional[AutoscalingConfig] = None
 
 
 class Deployment:
@@ -48,12 +67,21 @@ class Deployment:
         self.name = name
         self.config = config
 
-    def options(self, *, name: Optional[str] = None, num_replicas: Optional[int] = None,
+    def options(self, *, name: Optional[str] = None, num_replicas=None,
                 max_ongoing_requests: Optional[int] = None,
-                ray_actor_options: Optional[Dict[str, Any]] = None) -> "Deployment":
+                ray_actor_options: Optional[Dict[str, Any]] = None,
+                autoscaling_config: Optional[dict] = None) -> "Deployment":
         cfg = copy.deepcopy(self.config)
-        if num_replicas is not None:
+        if num_replicas == "auto" or autoscaling_config is not None:
+            if isinstance(num_replicas, int) and num_replicas != 1:
+                raise ValueError(
+                    "num_replicas and autoscaling_config are mutually "
+                    "exclusive; set min/max_replicas in the config instead")
+            cfg.autoscaling = AutoscalingConfig.from_dict(autoscaling_config or {})
+            cfg.num_replicas = cfg.autoscaling.min_replicas
+        elif num_replicas is not None:
             cfg.num_replicas = num_replicas
+            cfg.autoscaling = None
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
         if ray_actor_options is not None:
@@ -71,16 +99,28 @@ class Application:
     init_kwargs: dict
 
 
-def deployment(target=None, *, name: Optional[str] = None, num_replicas: int = 1,
+def deployment(target=None, *, name: Optional[str] = None, num_replicas=1,
                max_ongoing_requests: int = 16,
-               ray_actor_options: Optional[Dict[str, Any]] = None):
-    """@serve.deployment on a class or function."""
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               autoscaling_config: Optional[dict] = None):
+    """@serve.deployment on a class or function. ``num_replicas="auto"`` or
+    an ``autoscaling_config`` dict enables request-driven autoscaling."""
 
     def wrap(t):
+        auto = None
+        n = num_replicas
+        if num_replicas == "auto" or autoscaling_config is not None:
+            if isinstance(num_replicas, int) and num_replicas != 1:
+                raise ValueError(
+                    "num_replicas and autoscaling_config are mutually "
+                    "exclusive; set min/max_replicas in the config instead")
+            auto = AutoscalingConfig.from_dict(autoscaling_config or {})
+            n = auto.min_replicas
         cfg = DeploymentConfig(
-            num_replicas=num_replicas,
+            num_replicas=n,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=ray_actor_options or {"num_cpus": 1.0},
+            autoscaling=auto,
         )
         return Deployment(t, name or t.__name__, cfg)
 
@@ -112,9 +152,15 @@ class _Replica:
         self._num_ongoing = 0
 
     async def handle_request(self, method_name: str, args_blob: bytes):
+        import contextvars as _cv
+
         import cloudpickle as _cp
 
+        from ray_tpu.serve.multiplex import _set_current_model_id
+
         args, kwargs = _cp.loads(args_blob)
+        model_id = kwargs.pop("_serve_multiplexed_model_id", "")
+        token = _set_current_model_id(model_id)
         self._num_ongoing += 1
         try:
             if method_name == "__call__":
@@ -126,10 +172,12 @@ class _Replica:
             if asyncio.iscoroutinefunction(fn):
                 out = await fn(*args, **kwargs)
             else:
-                # sync user code runs off-loop so it can call other handles
+                # sync user code runs off-loop so it can call other handles;
+                # copy the context so get_multiplexed_model_id() works there
                 loop = asyncio.get_event_loop()
+                ctx = _cv.copy_context()
                 out = await loop.run_in_executor(
-                    None, functools.partial(fn, *args, **kwargs))
+                    None, functools.partial(ctx.run, fn, *args, **kwargs))
                 if asyncio.iscoroutine(out):
                     out = await out
             return out
@@ -156,42 +204,91 @@ def _resolve_app_args(v):
 
 @ray_tpu.remote
 class _ServeController:
-    """Reconciles target replica sets; restarts dead replicas."""
+    """Reconciles target replica sets; restarts dead replicas; runs the
+    request-driven autoscaler (reference: _private/controller.py reconcile
+    loop + autoscaling_state.py); publishes versioned topology with a
+    long-poll wait (reference: _private/long_poll.py)."""
 
     def __init__(self):
-        self.apps: Dict[str, dict] = {}  # name -> {blob, init, cfg, replicas}
+        import threading as _th
+
+        self.apps: Dict[str, dict] = {}  # name -> {blob, init, cfg, replicas,
+        #                                           version, target, scale_ts}
         self._running = True
+        self._loop_started = False
+        self._cv = _th.Condition()
+        # serializes deploy/delete vs the control loop's reconcile/autoscale
+        # (both run on executor threads)
+        self._mutate = _th.RLock()
+
+    def _bump(self, name: str):
+        with self._cv:
+            app = self.apps.get(name)
+            if app is not None:
+                app["version"] += 1
+            self._cv.notify_all()
 
     def deploy(self, name: str, target_blob: bytes, init_blob: bytes,
                cfg_blob: bytes) -> bool:
         import cloudpickle as _cp
 
         cfg = _cp.loads(cfg_blob)
-        old = self.apps.get(name)
-        if old:
-            for r in old["replicas"]:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
-        self.apps[name] = {"blob": target_blob, "init": init_blob, "cfg": cfg,
-                           "replicas": []}
-        self._reconcile(name)
+        with self._mutate:
+            old = self.apps.get(name)
+            version = 0
+            if old:
+                # versions survive redeploys so long-pollers can't collide
+                # with the new app's counter and miss the change
+                version = old["version"] + 1
+                for r in old["replicas"]:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+            self.apps[name] = {"blob": target_blob, "init": init_blob,
+                               "cfg": cfg, "replicas": [], "version": version,
+                               "target": cfg.num_replicas,
+                               "scale_up_since": None, "scale_down_since": None}
+            self._reconcile(name)
         return True
 
     def _reconcile(self, name: str):
         from ray_tpu.serve import api as _api
 
+        import time as _t
+
         app = self.apps[name]
         cfg = app["cfg"]
-        want = cfg.num_replicas
+        want = app["target"]
+        strikes = app.setdefault("strikes", {})
         alive = []
-        for r in app["replicas"]:
+        # batched health checks under ONE deadline: a single wedged replica
+        # must not stall the loop 10s per replica per app
+        health_refs = [(r, r.health.remote()) for r in app["replicas"]]
+        deadline = _t.monotonic() + 10.0
+        for r, ref in health_refs:
             try:
-                ray_tpu.get(r.health.remote(), timeout=10)
+                ray_tpu.get(ref, timeout=max(0.5, deadline - _t.monotonic()))
+                strikes.pop(r, None)
                 alive.append(r)
-            except Exception:
-                pass
+            except Exception as e:
+                from ray_tpu.exceptions import ActorDiedError
+
+                cause = getattr(e, "cause", None)
+                dead = isinstance(e, ActorDiedError) or isinstance(
+                    cause, ActorDiedError) or "ActorDied" in str(e)
+                # a slow health check under load is not death: give a
+                # replica three strikes before replacing it
+                strikes[r] = strikes.get(r, 0) + 1
+                if not dead and strikes[r] < 3:
+                    alive.append(r)
+                else:
+                    strikes.pop(r, None)
+                    try:
+                        ray_tpu.kill(r)  # don't leak the struck-out actor
+                    except Exception:
+                        pass
+        changed = len(alive) != len(app["replicas"])
         while len(alive) < want:
             opts = dict(cfg.ray_actor_options)
             replica = _api._Replica.options(
@@ -202,17 +299,84 @@ class _ServeController:
                 max_restarts=-1,
             ).remote(app["blob"], app["init"])
             alive.append(replica)
+            changed = True
         for extra in alive[want:]:
+            changed = True
             try:
                 ray_tpu.kill(extra)
             except Exception:
                 pass
         app["replicas"] = alive[:want]
+        if changed:
+            self._bump(name)
+
+    def _autoscale(self, name: str):
+        """Average ongoing requests per replica vs. target, with up/down
+        delay smoothing (reference: autoscaling_policy.py)."""
+        import time as _t
+
+        app = self.apps[name]
+        auto: AutoscalingConfig = app["cfg"].autoscaling
+        if auto is None or not app["replicas"]:
+            return
+        try:
+            ongoing = ray_tpu.get(
+                [r.num_ongoing.remote() for r in app["replicas"]], timeout=10)
+        except Exception:
+            return
+        avg = sum(ongoing) / max(len(ongoing), 1)
+        now = _t.monotonic()
+        target = app["target"]
+        if avg > auto.target_ongoing_requests and target < auto.max_replicas:
+            app["scale_down_since"] = None
+            if app["scale_up_since"] is None:
+                app["scale_up_since"] = now
+            if now - app["scale_up_since"] >= auto.upscale_delay_s:
+                # scale to what the load implies, clamped
+                want = min(auto.max_replicas, max(
+                    target + 1,
+                    int(round(avg * len(ongoing)
+                              / auto.target_ongoing_requests))))
+                app["target"] = want
+                app["scale_up_since"] = None
+        elif (avg < auto.target_ongoing_requests * 0.5
+                and target > auto.min_replicas):
+            app["scale_up_since"] = None
+            if app["scale_down_since"] is None:
+                app["scale_down_since"] = now
+            if now - app["scale_down_since"] >= auto.downscale_delay_s:
+                app["target"] = max(auto.min_replicas, target - 1)
+                app["scale_down_since"] = None
+        else:
+            app["scale_up_since"] = None
+            app["scale_down_since"] = None
+
+    def run_control_loop(self):
+        """Blocking reconcile+autoscale loop; started once by serve.run
+        (runs on one of the controller's executor threads)."""
+        import time as _t
+
+        if self._loop_started:
+            return False
+        self._loop_started = True
+        while self._running:
+            for name in list(self.apps):
+                try:
+                    with self._mutate:
+                        if name in self.apps:
+                            self._autoscale(name)
+                            self._reconcile(name)
+                except Exception:
+                    pass
+            _t.sleep(0.5)
+        return True
 
     def check_replicas(self):
-        """Periodic health reconcile (driven by handle/proxy pings)."""
+        """One reconcile pass (also available to tests/handles)."""
         for name in list(self.apps):
-            self._reconcile(name)
+            with self._mutate:
+                if name in self.apps:
+                    self._reconcile(name)
         return True
 
     def get_replicas(self, name: str):
@@ -221,20 +385,54 @@ class _ServeController:
             raise KeyError(f"no deployment named {name!r}")
         return list(app["replicas"])
 
+    def get_topology(self, name: str):
+        """Versioned replica set for handle caches."""
+        app = self.apps.get(name)
+        if app is None:
+            raise KeyError(f"no deployment named {name!r}")
+        return {"version": app["version"], "replicas": list(app["replicas"])}
+
+    async def poll_topology(self, name: str, version: int, timeout: float = 25.0):
+        """Long-poll: returns when the replica set version moves past
+        ``version`` (or on timeout, with the current state). Async so a
+        waiting poller costs no executor thread (reference:
+        serve/_private/long_poll.py LongPollHost). 100ms check granularity.
+        """
+        import time as _t
+
+        deadline = _t.monotonic() + timeout
+        while True:
+            app = self.apps.get(name)
+            if app is None:
+                return {"version": -1, "replicas": []}
+            if app["version"] != version or _t.monotonic() >= deadline:
+                return {"version": app["version"],
+                        "replicas": list(app["replicas"])}
+            await asyncio.sleep(0.1)
+
     def delete(self, name: str) -> bool:
-        app = self.apps.pop(name, None)
-        if app:
-            for r in app["replicas"]:
-                try:
-                    ray_tpu.kill(r)
-                except Exception:
-                    pass
+        with self._mutate:
+            app = self.apps.pop(name, None)
+            if app:
+                for r in app["replicas"]:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+        with self._cv:
+            self._cv.notify_all()
+        return True
+
+    def stop_loops(self):
+        self._running = False
         return True
 
     def status(self) -> Dict[str, Any]:
         return {
             name: {"num_replicas": len(app["replicas"]),
-                   "target": app["cfg"].num_replicas}
+                   "target": app["target"],
+                   "version": app["version"],
+                   "autoscaling": app["cfg"].autoscaling is not None}
             for name, app in self.apps.items()
         }
 
@@ -256,18 +454,28 @@ def _get_controller(create: bool = True):
 
 
 class DeploymentHandle:
-    """Client-side router: power-of-two-choices over replica pending counts."""
+    """Client-side router: power-of-two-choices over replica pending counts,
+    fed by the controller's versioned topology (long-pollable)."""
 
-    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self._name = deployment_name
         self._method = method_name
+        self._model_id = multiplexed_model_id
         self._replicas: List[Any] = []
+        self._version = -1
         self._pending: Dict[Any, int] = {}
         self._last_refresh = 0.0
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        h = DeploymentHandle(self._name, method_name)
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self._name,
+            method_name if method_name is not None else self._method,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._model_id)
         h._replicas = self._replicas
+        h._version = self._version
         h._pending = self._pending
         return h
 
@@ -275,21 +483,53 @@ class DeploymentHandle:
         if not force and self._replicas and time.monotonic() - self._last_refresh < 5.0:
             return
         controller = _get_controller(create=False)
-        self._replicas = ray_tpu.get(
-            controller.get_replicas.remote(self._name), timeout=60)
+        topo = ray_tpu.get(
+            controller.get_topology.remote(self._name), timeout=60)
+        self._replicas = topo["replicas"]
+        self._version = topo["version"]
         self._pending = {r: 0 for r in self._replicas}
         self._last_refresh = time.monotonic()
+
+    def _long_poll_refresh(self, timeout: float = 25.0):
+        """Blocking topology watch (proxies use this in a background
+        thread); returns True if the replica set changed."""
+        controller = _get_controller(create=False)
+        topo = ray_tpu.get(controller.poll_topology.remote(
+            self._name, self._version, timeout), timeout=timeout + 30)
+        changed = topo["version"] != self._version
+        self._replicas = topo["replicas"]
+        self._version = topo["version"]
+        if changed:
+            self._pending = {r: 0 for r in self._replicas}
+        self._last_refresh = time.monotonic()
+        return changed
 
     def _pick(self):
         self._refresh()
         if not self._replicas:
-            raise RuntimeError(f"deployment {self._name} has no replicas")
+            # replicas may be mid-restart: re-ask the controller (it
+            # reconciles on demand) before giving up
+            deadline = time.monotonic() + 30.0
+            while not self._replicas and time.monotonic() < deadline:
+                time.sleep(0.2)
+                try:
+                    self._refresh(force=True)
+                except Exception:
+                    pass
+            if not self._replicas:
+                raise RuntimeError(f"deployment {self._name} has no replicas")
         if len(self._replicas) == 1:
             return self._replicas[0]
         a, b = random.sample(self._replicas, 2)
         return a if self._pending.get(a, 0) <= self._pending.get(b, 0) else b
 
     def remote(self, *args, **kwargs):
+        if self._model_id:
+            # model multiplexing: the same model id sticks to the same
+            # replica so its model cache stays hot (reference:
+            # serve/multiplex.py + prefix-aware routing)
+            kwargs["_serve_multiplexed_model_id"] = self._model_id
+            return self.remote_with_key(self._model_id, *args, **kwargs)
         replica = self._pick()
         return self._dispatch(replica, args, kwargs)
 
@@ -299,6 +539,9 @@ class DeploymentHandle:
         import hashlib
 
         self._refresh()
+        if not self._replicas:
+            replica = self._pick()  # waits for replicas / raises
+            return self._dispatch(replica, args, kwargs)
         if len(self._replicas) > 1:
             digest = hashlib.md5(routing_key.encode()).digest()
             replica = self._replicas[
@@ -314,7 +557,7 @@ class DeploymentHandle:
         return replica.handle_request.remote(self._method, blob)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name, self._method))
+        return (DeploymentHandle, (self._name, self._method, self._model_id))
 
 
 def get_app_handle(name: str) -> DeploymentHandle:
@@ -338,6 +581,12 @@ def run(app: Application, name: Optional[str] = None, *,
         cloudpickle.dumps(dep._target),
         cloudpickle.dumps((app.init_args, app.init_kwargs)),
         cloudpickle.dumps(dep.config)), timeout=600)
+    from ray_tpu._private.worker import global_worker
+
+    if global_worker().mode != "local":
+        # local mode executes actor calls inline, so the blocking control
+        # loop must not start there (health/autoscaling don't apply anyway)
+        controller.run_control_loop.remote()  # idempotent; fire-and-forget
     handle = DeploymentHandle(deploy_name)
     handle._refresh(force=True)
     return handle

@@ -1,0 +1,191 @@
+"""Serve autoscaling / long-poll / multiplexing tests (reference tier:
+serve/tests/test_autoscaling_policy.py, test_long_poll.py,
+test_multiplex.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=10)
+    yield ray_tpu
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+def test_autoscaling_up_and_down(cluster):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0,
+        "upscale_delay_s": 0.5, "downscale_delay_s": 1.5})
+    class Slow:
+        def __call__(self, body):
+            time.sleep(0.4)
+            return "ok"
+
+    handle = serve.run(Slow.bind())
+    assert serve.status()["Slow"]["num_replicas"] == 1
+
+    # sustained burst -> scale up
+    refs = [handle.remote({}) for _ in range(24)]
+    deadline = time.monotonic() + 60
+    scaled_up = False
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["target"] >= 2:
+            scaled_up = True
+            break
+        time.sleep(0.3)
+    assert scaled_up, f"never scaled up: {serve.status()}"
+    ray_tpu.get(refs, timeout=120)
+
+    # idle -> scale back down to min
+    deadline = time.monotonic() + 60
+    scaled_down = False
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["target"] == 1:
+            scaled_down = True
+            break
+        time.sleep(0.5)
+    assert scaled_down, f"never scaled down: {serve.status()}"
+    serve.delete("Slow")
+
+
+def test_long_poll_topology_updates(cluster):
+    @serve.deployment(num_replicas=1)
+    def echo(body):
+        return body
+
+    handle = serve.run(echo.bind(), name="lp_echo")
+    v0 = handle._version
+    # redeploy with more replicas; a long-poll wakes when topology changes
+    import threading
+
+    changed = {}
+
+    def watch():
+        # interim bumps (health-driven replacements) may wake the poll
+        # before the redeploy lands; keep polling until 2 replicas appear
+        deadline = time.monotonic() + 40
+        result = False
+        while time.monotonic() < deadline:
+            result = handle._long_poll_refresh(timeout=10.0) or result
+            if len(handle._replicas) == 2:
+                break
+        changed["result"] = result
+
+    t = threading.Thread(target=watch)
+    t.start()
+    time.sleep(0.3)
+    serve.run(echo.options(num_replicas=2).bind(), name="lp_echo")
+    t.join(timeout=50)
+    assert not t.is_alive()
+    assert changed["result"] is True
+    assert handle._version != v0
+    assert len(handle._replicas) == 2
+    serve.delete("lp_echo")
+
+
+def test_multiplexed_models(cluster):
+    @serve.deployment(num_replicas=2)
+    class Zoo:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "bias": len(self.loads)}
+
+        async def __call__(self, body):
+            model_id = serve.get_multiplexed_model_id()
+            model = await self.get_model(model_id)
+            return {"model": model["id"], "loads": list(self.loads)}
+
+    handle = serve.run(Zoo.bind())
+    m1 = handle.options(multiplexed_model_id="m1")
+    outs = ray_tpu.get([m1.remote({}) for _ in range(4)], timeout=120)
+    assert all(o["model"] == "m1" for o in outs)
+    # same id -> same replica -> loaded exactly once
+    assert all(o["loads"].count("m1") == 1 for o in outs)
+
+    m2 = handle.options(multiplexed_model_id="m2")
+    out2 = ray_tpu.get(m2.remote({}), timeout=120)
+    assert out2["model"] == "m2"
+    serve.delete("Zoo")
+
+
+def test_redeploy_version_monotonic(cluster):
+    @serve.deployment(num_replicas=1)
+    def f(body):
+        return 1
+
+    h1 = serve.run(f.bind(), name="vmono")
+    v1 = h1._version
+    h2 = serve.run(f.options(num_replicas=2).bind(), name="vmono")
+    assert h2._version > v1  # never collides across redeploys
+    serve.delete("vmono")
+
+
+def test_multiplexed_single_flight(cluster):
+    @serve.deployment(num_replicas=1)
+    class Zoo:
+        def __init__(self):
+            self.loads = 0
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            import asyncio as aio
+
+            self.loads += 1
+            await aio.sleep(0.3)  # slow load window for the race
+            return model_id
+
+        async def __call__(self, body):
+            await self.get_model("m")
+            return self.loads
+
+    handle = serve.run(Zoo.bind(), name="sflight")
+    outs = ray_tpu.get([handle.remote({}) for _ in range(4)], timeout=120)
+    assert max(outs) == 1, f"model loaded {max(outs)} times concurrently"
+    serve.delete("sflight")
+
+
+def test_num_replicas_conflict_rejected(cluster):
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        serve.deployment(num_replicas=3,
+                         autoscaling_config={"min_replicas": 1})(lambda b: b)
+    with pytest.raises(ValueError, match="unknown autoscaling_config"):
+        serve.deployment(autoscaling_config={"max_replica": 2})(lambda b: b)
+
+
+def test_multiplexed_lru_eviction(cluster):
+    @serve.deployment(num_replicas=1)
+    class Zoo:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return model_id
+
+        async def __call__(self, body):
+            await self.get_model(body["m"])
+            return list(self.loads)
+
+    handle = serve.run(Zoo.bind(), name="lru_zoo")
+    ray_tpu.get(handle.remote({"m": "a"}), timeout=120)
+    ray_tpu.get(handle.remote({"m": "b"}), timeout=60)
+    ray_tpu.get(handle.remote({"m": "c"}), timeout=60)  # evicts "a"
+    loads = ray_tpu.get(handle.remote({"m": "a"}), timeout=60)  # reload
+    assert loads == ["a", "b", "c", "a"]
+    serve.delete("lru_zoo")
