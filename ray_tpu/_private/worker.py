@@ -90,6 +90,15 @@ class LocalWorker:
             out.append(value)
         return out[0] if single else out
 
+    def _run_coroutine(self, coro):
+        """One persistent private loop: async actors may stash loop-bound
+        futures across calls, and py3.12's get_event_loop() no longer
+        conjures a loop in the main thread."""
+        loop = getattr(self, "_loop", None)
+        if loop is None or loop.is_closed():
+            loop = self._loop = asyncio.new_event_loop()
+        return loop.run_until_complete(coro)
+
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         ready = [r for r in refs if r.id in self._objects]
         return ready[:num_returns], [r for r in refs if r not in ready[:num_returns]]
@@ -118,7 +127,7 @@ class LocalWorker:
             args, kwargs = self._resolve_args(args, kwargs)
             result = fn(*args, **kwargs)
             if inspect.iscoroutine(result):
-                result = asyncio.get_event_loop().run_until_complete(result)
+                result = self._run_coroutine(result)
             if num_returns == 1:
                 self._store_result(refs[0].id, result)
             else:

@@ -1,6 +1,18 @@
 """ray_tpu.tune: hyperparameter search (reference: ray.tune)."""
 
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.searchers import (
+    BasicVariantSearcher,
+    QuasiRandomSearcher,
+    Searcher,
+    TPESearcher,
+)
 from ray_tpu.tune.search import choice, grid_search, loguniform, randint, uniform
 from ray_tpu.tune.tuner import (
     ResultGrid,
@@ -24,5 +36,12 @@ __all__ = [
     "loguniform",
     "randint",
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Searcher",
+    "BasicVariantSearcher",
+    "QuasiRandomSearcher",
+    "TPESearcher",
     "FIFOScheduler",
 ]

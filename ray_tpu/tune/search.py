@@ -19,6 +19,9 @@ class GridSearch:
 @dataclass
 class Sampler:
     sample: Callable[[random.Random], Any]
+    # inverse CDF: maps a quantile u in [0,1) to a value (lets quasi-random
+    # searchers keep their low-discrepancy stratification)
+    ppf: Callable[[float], Any] = None
 
 
 def grid_search(values: List[Any]) -> GridSearch:
@@ -27,21 +30,26 @@ def grid_search(values: List[Any]) -> GridSearch:
 
 def choice(values: List[Any]) -> Sampler:
     values = list(values)
-    return Sampler(lambda rng: rng.choice(values))
+    return Sampler(lambda rng: rng.choice(values),
+                   ppf=lambda u: values[min(int(u * len(values)), len(values) - 1)])
 
 
 def uniform(low: float, high: float) -> Sampler:
-    return Sampler(lambda rng: rng.uniform(low, high))
+    return Sampler(lambda rng: rng.uniform(low, high),
+                   ppf=lambda u: low + u * (high - low))
 
 
 def loguniform(low: float, high: float) -> Sampler:
     import math
 
-    return Sampler(lambda rng: math.exp(rng.uniform(math.log(low), math.log(high))))
+    return Sampler(
+        lambda rng: math.exp(rng.uniform(math.log(low), math.log(high))),
+        ppf=lambda u: math.exp(math.log(low) + u * (math.log(high) - math.log(low))))
 
 
 def randint(low: int, high: int) -> Sampler:
-    return Sampler(lambda rng: rng.randrange(low, high))
+    return Sampler(lambda rng: rng.randrange(low, high),
+                   ppf=lambda u: min(low + int(u * (high - low)), high - 1))
 
 
 def generate_variants(param_space: Dict[str, Any], num_samples: int,

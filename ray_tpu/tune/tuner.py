@@ -19,13 +19,22 @@ import cloudpickle
 import ray_tpu
 from ray_tpu.exceptions import TaskError
 from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
-from ray_tpu.tune.search import generate_variants
 
 _session = threading.local()
 
 
 class TuneStopException(Exception):
     """Raised inside a trial when the scheduler stops it early."""
+
+
+class TuneExploitException(Exception):
+    """Raised inside a trial when PBT replaces it with a better trial's
+    checkpoint + perturbed config; the tuner restarts the trial."""
+
+    def __init__(self, config, checkpoint):
+        super().__init__("pbt exploit")
+        self.config = config
+        self.checkpoint = checkpoint
 
 
 @dataclass
@@ -35,6 +44,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 4
     scheduler: Any = None
+    search_alg: Any = None  # a tune.searchers.Searcher proposing configs
     seed: int = 0
 
 
@@ -85,12 +95,28 @@ class _ReportHub:
         self.latest: Dict[str, Dict] = {}
         self.iters: Dict[str, int] = {}
 
-    def report(self, trial_id: str, metrics: Dict) -> str:
+    def register_trial(self, trial_id: str, config: Dict):
+        # PBT needs trial configs for exploit mutation
+        hook = getattr(self.scheduler, "register_trial", None)
+        if hook is not None:
+            hook(trial_id, config)
+        return True
+
+    def report(self, trial_id: str, metrics: Dict, checkpoint=None):
         self.iters[trial_id] = self.iters.get(trial_id, 0) + 1
         metrics = dict(metrics)
         metrics.setdefault("training_iteration", self.iters[trial_id])
         self.latest[trial_id] = metrics
+        if checkpoint is not None:
+            hook = getattr(self.scheduler, "record_checkpoint", None)
+            if hook is not None:
+                hook(trial_id, checkpoint)
         return self.scheduler.on_result(trial_id, metrics)
+
+    def reset_iters(self, trial_id: str):
+        """An exploited trial restarts its iteration counter."""
+        self.iters.pop(trial_id, None)
+        return True
 
     def get_latest(self):
         return dict(self.latest)
@@ -112,19 +138,27 @@ def _run_trial(fn_blob: bytes, config, trial_id: str, hub) -> Dict:
         return {"metrics": out if isinstance(out, dict) else {}, "stopped": False}
     except _tuner.TuneStopException:
         return {"metrics": {}, "stopped": True}
+    except _tuner.TuneExploitException as e:
+        return {"metrics": {}, "exploit": {"config": e.config,
+                                           "checkpoint": e.checkpoint}}
     finally:
         _tuner._session.hub = None
 
 
 def report(metrics: Dict[str, Any], checkpoint=None):
-    """tune.report inside a trial; raises TuneStopException on ASHA stop."""
+    """tune.report inside a trial. Raises TuneStopException when the
+    scheduler stops the trial, TuneExploitException when PBT replaces it
+    with a better trial's state."""
     hub = getattr(_session, "hub", None)
     if hub is None:
         raise RuntimeError("tune.report called outside a trial")
     decision = ray_tpu.get(
-        hub.report.remote(_session.trial_id, metrics), timeout=300)
+        hub.report.remote(_session.trial_id, metrics, checkpoint), timeout=300)
     if decision == STOP:
         raise TuneStopException()
+    if isinstance(decision, (tuple, list)) and decision and decision[0] == "EXPLOIT":
+        payload = decision[1]
+        raise TuneExploitException(payload["config"], payload["checkpoint"])
 
 
 class Tuner:
@@ -140,26 +174,48 @@ class Tuner:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         tc = self.tune_config
-        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
         scheduler = tc.scheduler or FIFOScheduler()
+        searcher = tc.search_alg
+        if searcher is None:
+            from ray_tpu.tune.searchers import BasicVariantSearcher
+
+            searcher = BasicVariantSearcher(self.param_space, tc.num_samples,
+                                            tc.seed)
         hub = _ReportHub.options(
             name=f"tune_hub_{uuid.uuid4().hex[:8]}", max_concurrency=16,
         ).remote(cloudpickle.dumps(scheduler))
         fn_blob = cloudpickle.dumps(self.trainable)
 
-        pending = [(f"trial_{i:05d}", cfg) for i, cfg in enumerate(variants)]
+        pending: List[tuple] = []
         running: Dict[Any, tuple] = {}
         results: List[TrialResult] = []
-        while pending or running:
+        trial_seq = 0
+        exhausted = False
+
+        def launch(trial_id, cfg):
+            ray_tpu.get(hub.register_trial.remote(trial_id, cfg), timeout=60)
+            ref = _run_trial.options(
+                num_cpus=self.resources.get("CPU", 1.0),
+                num_tpus=self.resources.get("TPU", 0.0),
+                resources={k: v for k, v in self.resources.items()
+                           if k not in ("CPU", "TPU")},
+            ).remote(fn_blob, cfg, trial_id, hub)
+            running[ref] = (trial_id, cfg)
+
+        while True:
+            # refill from exploit-requeues first, then the searcher
             while pending and len(running) < tc.max_concurrent_trials:
-                trial_id, cfg = pending.pop(0)
-                ref = _run_trial.options(
-                    num_cpus=self.resources.get("CPU", 1.0),
-                    num_tpus=self.resources.get("TPU", 0.0),
-                    resources={k: v for k, v in self.resources.items()
-                               if k not in ("CPU", "TPU")},
-                ).remote(fn_blob, cfg, trial_id, hub)
-                running[ref] = (trial_id, cfg)
+                launch(*pending.pop(0))
+            while not exhausted and len(running) < tc.max_concurrent_trials:
+                trial_id = f"trial_{trial_seq:05d}"
+                cfg = searcher.suggest(trial_id)
+                if cfg is None:
+                    exhausted = True
+                    break
+                trial_seq += 1
+                launch(trial_id, cfg)
+            if not running and not pending and exhausted:
+                break
             ready, _ = ray_tpu.wait(list(running.keys()), num_returns=1,
                                     timeout=1.0)
             for ref in ready:
@@ -168,13 +224,27 @@ class Tuner:
                     trial_id, {})
                 try:
                     out = ray_tpu.get(ref, timeout=60)
-                    final = dict(latest)
-                    final.update(out.get("metrics") or {})
-                    results.append(TrialResult(trial_id, cfg, final,
-                                               stopped_early=out.get("stopped",
-                                                                     False)))
                 except TaskError as e:
                     results.append(TrialResult(trial_id, cfg, latest,
                                                error=str(e)[:500]))
+                    searcher.on_trial_complete(
+                        trial_id, {**latest, "__config__": cfg})
+                    continue
+                exploit = out.get("exploit")
+                if exploit is not None:
+                    # PBT: restart this trial from the donor's checkpoint
+                    # with the perturbed config
+                    new_cfg = dict(exploit["config"])
+                    new_cfg["__checkpoint__"] = exploit["checkpoint"]
+                    ray_tpu.get(hub.reset_iters.remote(trial_id), timeout=60)
+                    pending.append((trial_id, new_cfg))
+                    continue
+                final = dict(latest)
+                final.update(out.get("metrics") or {})
+                results.append(TrialResult(trial_id, cfg, final,
+                                           stopped_early=out.get("stopped",
+                                                                 False)))
+                searcher.on_trial_complete(
+                    trial_id, {**final, "__config__": cfg})
         ray_tpu.kill(hub)
         return ResultGrid(results, tc.metric, tc.mode)
