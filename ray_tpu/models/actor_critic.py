@@ -38,3 +38,51 @@ class ActorCritic(nn.Module):
                           kernel_init=nn.initializers.orthogonal(0.01))(x)
         v = nn.Dense(1, name="vf", kernel_init=nn.initializers.orthogonal(1.0))(x)
         return logits, v[..., 0]
+
+
+class SquashedGaussianActor(nn.Module):
+    """Tanh-squashed diagonal Gaussian policy for continuous control
+    (reference: rllib SAC's action distribution). ``sample`` returns
+    (action in [-1,1]^d, log_prob with the tanh change-of-variables
+    correction)."""
+
+    act_dim: int
+    hidden: Tuple[int, ...] = (128, 128)
+    log_std_min: float = -10.0
+    log_std_max: float = 2.0
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        mu = nn.Dense(self.act_dim)(x)
+        log_std = nn.Dense(self.act_dim)(x)
+        log_std = jnp.clip(log_std, self.log_std_min, self.log_std_max)
+        return mu, log_std
+
+    def sample(self, obs: jax.Array, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        mu, log_std = self(obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mu.shape)
+        pre_tanh = mu + std * eps
+        action = jnp.tanh(pre_tanh)
+        # log N(pre_tanh; mu, std) - sum log(1 - tanh^2)
+        logp = (-0.5 * (((pre_tanh - mu) / std) ** 2
+                        + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+        logp -= (2 * (jnp.log(2.0) - pre_tanh
+                      - jax.nn.softplus(-2 * pre_tanh))).sum(-1)
+        return action, logp
+
+
+class ContinuousQ(nn.Module):
+    """Q(s, a) head for continuous actions (reference: SAC twin critics)."""
+
+    hidden: Tuple[int, ...] = (128, 128)
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(1)(x)[..., 0]

@@ -9,10 +9,18 @@ V-trace). All learners are jitted jax programs; env runners are actors.
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.offline import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig, PPOLearner
+from ray_tpu.rl.sac import SAC, SACConfig
 from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 
 __all__ = [
+    "SAC",
+    "SACConfig",
+    "BC",
+    "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
     "Algorithm", "AlgorithmConfig",
     "PPO", "PPOConfig", "PPOLearner",
     "DQN", "DQNConfig",

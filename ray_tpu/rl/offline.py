@@ -1,0 +1,215 @@
+"""Offline RL: behavior cloning (BC) and MARWIL.
+
+Reference: rllib/algorithms/bc + rllib/algorithms/marwil — learn a policy
+from a fixed dataset of (obs, action[, reward]) transitions with no
+environment interaction during training; MARWIL weights the imitation
+loss by exponentiated advantages against a learned value baseline
+(marwil.py's beta). Datasets ride ray_tpu.data (reference: offline data on
+ray.data)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+
+
+@dataclass
+class BCConfig(AlgorithmConfig):
+    env: str = "CartPole-v1"
+    # dataset for cfg.build(): dict of arrays or a ray_tpu.data.Dataset
+    offline_data: Any = None
+    lr: float = 1e-3
+    batch_size: int = 256
+    updates_per_iteration: int = 32
+    hidden: tuple = (64, 64)
+    # MARWIL advantage weighting; 0.0 == plain BC (reference: marwil.py beta)
+    beta: float = 0.0
+    vf_coef: float = 1.0
+    gamma: float = 0.99
+    eval_episodes: int = 8
+
+    @property
+    def algo_cls(self):
+        return BC
+
+
+@dataclass
+class MARWILConfig(BCConfig):
+    beta: float = 1.0
+
+    @property
+    def algo_cls(self):
+        return MARWIL
+
+
+class _OfflineLearner:
+    """jit-compiled weighted-imitation update over an offline batch."""
+
+    def __init__(self, cfg: BCConfig, obs_dim: int, n_actions: int):
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.actor_critic import ActorCritic
+
+        self.cfg = cfg
+        self.model = ActorCritic(n_actions, cfg.hidden)
+        key = jax.random.PRNGKey(cfg.seed)
+        self.params = self.model.init(key, jnp.zeros((1, obs_dim)))["params"]
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._jax = jax
+
+        def loss_fn(params, batch):
+            logits, values = self.model.apply({"params": params}, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            if cfg.beta > 0.0:
+                adv = batch["returns"] - values
+                # center + scale: below-average transitions (e.g. random
+                # filler in a mixed dataset) get exponentially small weight
+                # even before the value baseline converges
+                norm = (adv - adv.mean()) / (adv.std() + 1e-8)
+                weights = jnp.exp(cfg.beta * jax.lax.stop_gradient(norm))
+                weights = jnp.clip(weights, 0.0, 20.0)
+                pi_loss = -(weights * logp).mean()
+                vf_loss = (adv ** 2).mean()
+                total = pi_loss + cfg.vf_coef * vf_loss
+            else:
+                pi_loss = -logp.mean()
+                vf_loss = jnp.zeros(())
+                total = pi_loss
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss}
+
+        def update(carry, batch):
+            params, opt_state = carry
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), {"loss": loss, **aux}
+
+        self._update = jax.jit(update)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        (self.params, self.opt_state), metrics = self._update(
+            (self.params, self.opt_state), batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+
+class BC(Algorithm):
+    """Behavior cloning from an offline dataset.
+
+    The dataset may be a ``ray_tpu.data.Dataset`` of rows with
+    ``obs``/``actions`` (MARWIL additionally needs per-episode ``rewards``
+    + ``dones`` or precomputed ``returns``), or a plain dict of arrays via
+    ``config.offline_data``."""
+
+    def __init__(self, cfg: BCConfig, offline_data=None):
+        import gymnasium as gym
+
+        super().__init__(cfg)
+        self.cfg = cfg
+        if offline_data is None:
+            offline_data = cfg.offline_data
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        probe = gym.make(cfg.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+        self.learner = _OfflineLearner(cfg, obs_dim, n_actions)
+        self._data = self._load_data(offline_data)
+        self._rng = np.random.default_rng(cfg.seed)
+
+    def _load_data(self, offline_data) -> Dict[str, np.ndarray]:
+        if offline_data is None:
+            raise ValueError("BC/MARWIL require offline_data")
+        if isinstance(offline_data, dict):
+            data = {k: np.asarray(v) for k, v in offline_data.items()}
+        else:  # a ray_tpu.data.Dataset of row dicts
+            rows = offline_data.take_all()
+            keys = rows[0].keys()
+            data = {k: np.asarray([r[k] for r in rows]) for k in keys}
+        data["obs"] = data["obs"].astype(np.float32)
+        data["actions"] = data["actions"].astype(np.int32)
+        if self.cfg.beta > 0.0 and "returns" not in data:
+            data["returns"] = self._discounted_returns(data)
+        if "returns" in data:
+            r = data["returns"].astype(np.float32)
+            # standardize: the value head shares a torso with the policy, so
+            # unscaled-return regression gradients would swamp the
+            # imitation signal (advantages only need relative scale)
+            data["returns"] = (r - r.mean()) / (r.std() + 1e-8)
+        return data
+
+    def _discounted_returns(self, data) -> np.ndarray:
+        rewards = data["rewards"].astype(np.float32)
+        dones = data["dones"].astype(bool)
+        returns = np.zeros_like(rewards)
+        acc = 0.0
+        for i in reversed(range(len(rewards))):
+            acc = rewards[i] + self.cfg.gamma * (0.0 if dones[i] else acc)
+            returns[i] = acc
+        return returns
+
+    def training_step(self) -> Dict[str, Any]:
+        n = len(self._data["obs"])
+        metrics = {}
+        for _ in range(self.cfg.updates_per_iteration):
+            idx = self._rng.integers(0, n, self.cfg.batch_size)
+            batch = {k: v[idx] for k, v in self._data.items()
+                     if k in ("obs", "actions", "returns")}
+            metrics = self.learner.update(batch)
+        return metrics
+
+    def evaluate(self) -> Dict[str, float]:
+        """Greedy rollouts in the real env (reference: evaluation duration
+        on the Algorithm)."""
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+
+        env = gym.make(self.cfg.env)
+        apply = getattr(self, "_eval_apply", None)
+        if apply is None:
+            apply = self._eval_apply = jax.jit(
+                lambda p, o: self.learner.model.apply({"params": p}, o))
+        total = []
+        for ep in range(self.cfg.eval_episodes):
+            obs, _ = env.reset(seed=self.cfg.seed + ep)
+            done, ret = False, 0.0
+            while not done:
+                logits, _ = apply(self.learner.params,
+                                  jnp.asarray(obs, jnp.float32)[None])
+                action = int(jnp.argmax(logits[0]))
+                obs, rew, term, trunc, _ = env.step(action)
+                ret += float(rew)
+                done = term or trunc
+            total.append(ret)
+        env.close()
+        return {"episode_return_mean": float(np.mean(total))}
+
+    def get_state(self):
+        import jax
+
+        return {"params": jax.tree.map(np.asarray, self.learner.params)}
+
+    def set_state(self, state):
+        self.learner.params = state["params"]
+
+    def stop(self):
+        pass
+
+
+class MARWIL(BC):
+    """Advantage-weighted imitation (beta > 0)."""

@@ -1,0 +1,316 @@
+"""SAC: off-policy continuous control with entropy regularization.
+
+Reference: rllib/algorithms/sac — twin Q critics with target networks,
+tanh-squashed Gaussian actor, automatic entropy-temperature tuning
+(sac_torch_learner's alpha loss), env-runner actors feeding a replay
+buffer. TPU-first: the whole update (twin critics + actor + alpha +
+polyak) is one jit-compiled optax step.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+
+
+@dataclass
+class SACConfig(AlgorithmConfig):
+    env: str = "Pendulum-v1"
+    num_env_runners: int = 1
+    num_envs_per_runner: int = 4
+    rollout_length: int = 64
+    gamma: float = 0.99
+    tau: float = 0.01
+    lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    buffer_capacity: int = 100_000
+    batch_size: int = 256
+    updates_per_iteration: int = 32
+    warmup_steps: int = 1000
+    hidden: tuple = (128, 128)
+
+    @property
+    def algo_cls(self):
+        return SAC
+
+
+@ray_tpu.remote(num_cpus=1)
+class _SACRunner:
+    """Continuous-action sampler (squashed-Gaussian exploration)."""
+
+    def __init__(self, config_blob: bytes, worker_index: int):
+        import cloudpickle as _cp
+
+        from ray_tpu.rl.env_runner import EpisodeTracker, make_vec_env
+
+        self.cfg: SACConfig = _cp.loads(config_blob)
+        self.envs, self.obs = make_vec_env(
+            self.cfg.env, self.cfg.num_envs_per_runner,
+            self.cfg.seed + worker_index * 1000)
+        self._apply = None
+        self._rng_seed = self.cfg.seed * 9973 + worker_index
+        self.episodes = EpisodeTracker(self.cfg.num_envs_per_runner)
+        space = self.envs.single_action_space
+        self.act_low = np.asarray(space.low, np.float32)
+        self.act_high = np.asarray(space.high, np.float32)
+
+    def _policy(self):
+        if self._apply is None:
+            from ray_tpu.utils import import_jax
+
+            jax = import_jax()
+
+            from ray_tpu.models.actor_critic import SquashedGaussianActor
+
+            act_dim = int(np.prod(self.envs.single_action_space.shape))
+            model = SquashedGaussianActor(act_dim, self.cfg.hidden)
+            self._apply = jax.jit(
+                lambda params, obs, key: model.apply(
+                    {"params": params}, obs, key, method=model.sample))
+        return self._apply
+
+    def sample(self, params, random_actions: bool = False) -> Dict[str, np.ndarray]:
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+
+        T, N = self.cfg.rollout_length, self.cfg.num_envs_per_runner
+        act_shape = self.envs.single_action_space.shape
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N) + act_shape, np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        next_buf = np.zeros_like(obs_buf)
+        apply = self._policy()
+        key = jax.random.PRNGKey(self._rng_seed)
+        self._rng_seed += 1
+        scale = (self.act_high - self.act_low) / 2.0
+        mid = (self.act_high + self.act_low) / 2.0
+        for t in range(T):
+            if random_actions:
+                action = np.random.default_rng(self._rng_seed * 131 + t).uniform(
+                    -1.0, 1.0, (N,) + act_shape).astype(np.float32)
+            else:
+                key, sub = jax.random.split(key)
+                action, _ = apply(params, jnp.asarray(self.obs, jnp.float32), sub)
+                action = np.asarray(action)
+            env_action = action * scale + mid
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            self.obs, rew, term, trunc, info = self.envs.step(env_action)
+            from ray_tpu.rl.env_runner import true_next_obs
+
+            done = np.logical_or(term, trunc)
+            next_buf[t] = true_next_obs(self.obs, done, info)
+            rew_buf[t] = rew
+            # bootstrap through time-limit truncations (Pendulum always
+            # truncates): only true terminations cut the value target
+            done_buf[t] = term.astype(np.float32)
+            self.episodes.step(rew, done)
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
+        return {
+            "obs": flat(obs_buf), "actions": flat(act_buf),
+            "rewards": flat(rew_buf), "dones": flat(done_buf),
+            "next_obs": flat(next_buf),
+            "episode_returns": np.asarray(self.episodes.pop(), np.float32),
+        }
+
+
+class SACLearner:
+    """One jit step: twin-critic Bellman + actor + temperature + polyak."""
+
+    def __init__(self, cfg: SACConfig, obs_dim: int, act_dim: int):
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.actor_critic import ContinuousQ, SquashedGaussianActor
+
+        self.cfg = cfg
+        key = jax.random.PRNGKey(cfg.seed)
+        k1, k2, k3 = jax.random.split(key, 3)
+        self.actor = SquashedGaussianActor(act_dim, cfg.hidden)
+        self.q = ContinuousQ(cfg.hidden)
+        dummy_obs = jnp.zeros((1, obs_dim))
+        dummy_act = jnp.zeros((1, act_dim))
+        self.actor_params = self.actor.init(k1, dummy_obs)["params"]
+        self.q1_params = self.q.init(k2, dummy_obs, dummy_act)["params"]
+        self.q2_params = self.q.init(k3, dummy_obs, dummy_act)["params"]
+        self.q1_target = jax.tree.map(lambda x: x, self.q1_params)
+        self.q2_target = jax.tree.map(lambda x: x, self.q2_params)
+        self.log_alpha = jnp.zeros(())
+        self.target_entropy = -float(act_dim)
+
+        self.actor_opt = optax.adam(cfg.lr)
+        self.q_opt = optax.adam(cfg.lr)
+        self.alpha_opt = optax.adam(cfg.alpha_lr)
+        self.actor_opt_state = self.actor_opt.init(self.actor_params)
+        self.q1_opt_state = self.q_opt.init(self.q1_params)
+        self.q2_opt_state = self.q_opt.init(self.q2_params)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+
+        actor_model, q_model = self.actor, self.q
+
+        def sample_action(params, obs, key):
+            return actor_model.apply({"params": params}, obs, key,
+                                     method=actor_model.sample)
+
+        def step(state, batch, key):
+            (actor_params, q1, q2, q1_t, q2_t, log_alpha,
+             a_opt, q1_opt, q2_opt, al_opt) = state
+            alpha = jnp.exp(log_alpha)
+            key, k_next, k_pi = jax.random.split(key, 3)
+
+            # critic targets
+            next_act, next_logp = sample_action(actor_params, batch["next_obs"], k_next)
+            tq1 = q_model.apply({"params": q1_t}, batch["next_obs"], next_act)
+            tq2 = q_model.apply({"params": q2_t}, batch["next_obs"], next_act)
+            target_v = jnp.minimum(tq1, tq2) - alpha * next_logp
+            target = batch["rewards"] + cfg.gamma * (1.0 - batch["dones"]) * target_v
+            target = jax.lax.stop_gradient(target)
+
+            def q_loss(params):
+                pred = q_model.apply({"params": params}, batch["obs"], batch["actions"])
+                return ((pred - target) ** 2).mean()
+
+            q1_l, q1_g = jax.value_and_grad(q_loss)(q1)
+            q2_l, q2_g = jax.value_and_grad(q_loss)(q2)
+            upd, q1_opt = self.q_opt.update(q1_g, q1_opt, q1)
+            q1 = optax.apply_updates(q1, upd)
+            upd, q2_opt = self.q_opt.update(q2_g, q2_opt, q2)
+            q2 = optax.apply_updates(q2, upd)
+
+            # actor
+            def pi_loss(params):
+                act, logp = sample_action(params, batch["obs"], k_pi)
+                qv = jnp.minimum(
+                    q_model.apply({"params": q1}, batch["obs"], act),
+                    q_model.apply({"params": q2}, batch["obs"], act))
+                return (alpha * logp - qv).mean(), logp
+
+            (pi_l, logp), pi_g = jax.value_and_grad(pi_loss, has_aux=True)(actor_params)
+            upd, a_opt = self.actor_opt.update(pi_g, a_opt, actor_params)
+            actor_params = optax.apply_updates(actor_params, upd)
+
+            # temperature
+            def alpha_loss(la):
+                return (-jnp.exp(la) * jax.lax.stop_gradient(
+                    logp + self.target_entropy)).mean()
+
+            al_l, al_g = jax.value_and_grad(alpha_loss)(log_alpha)
+            upd, al_opt = self.alpha_opt.update(al_g, al_opt, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, upd)
+
+            # polyak
+            polyak = lambda t, s: jax.tree.map(  # noqa: E731
+                lambda a, b: a * (1 - cfg.tau) + b * cfg.tau, t, s)
+            q1_t = polyak(q1_t, q1)
+            q2_t = polyak(q2_t, q2)
+            new_state = (actor_params, q1, q2, q1_t, q2_t, log_alpha,
+                         a_opt, q1_opt, q2_opt, al_opt)
+            return new_state, {"q_loss": (q1_l + q2_l) / 2, "pi_loss": pi_l,
+                               "alpha": jnp.exp(log_alpha),
+                               "entropy": -logp.mean()}
+
+        self._step = jax.jit(step)
+        self._jax = jax
+        self._key = jax.random.PRNGKey(cfg.seed + 17)
+
+    def state_tuple(self):
+        return (self.actor_params, self.q1_params, self.q2_params,
+                self.q1_target, self.q2_target, self.log_alpha,
+                self.actor_opt_state, self.q1_opt_state, self.q2_opt_state,
+                self.alpha_opt_state)
+
+    def load_state_tuple(self, st):
+        (self.actor_params, self.q1_params, self.q2_params,
+         self.q1_target, self.q2_target, self.log_alpha,
+         self.actor_opt_state, self.q1_opt_state, self.q2_opt_state,
+         self.alpha_opt_state) = st
+
+    def update(self, batches: List[Dict[str, np.ndarray]]) -> Dict[str, float]:
+        jax = self._jax
+        st = self.state_tuple()
+        metrics = {}
+        for batch in batches:
+            self._key, sub = jax.random.split(self._key)
+            st, metrics = self._step(st, batch, sub)
+        self.load_state_tuple(st)
+        return {k: float(v) for k, v in metrics.items()}
+
+
+class SAC(Algorithm):
+    def __init__(self, cfg: SACConfig):
+        import cloudpickle
+
+        import gymnasium as gym
+
+        super().__init__(cfg)
+        self.cfg = cfg
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        probe = gym.make(cfg.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        act_dim = int(np.prod(probe.action_space.shape))
+        probe.close()
+        self.learner = SACLearner(cfg, obs_dim, act_dim)
+        blob = cloudpickle.dumps(cfg)
+        self.runners = [_SACRunner.remote(blob, i)
+                        for i in range(cfg.num_env_runners)]
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, cfg.seed)
+        self._steps_sampled = 0
+        self._return_window: List[float] = []
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        t0 = time.time()
+        params_np = jax.tree.map(np.asarray, self.learner.actor_params)
+        warmup = self._steps_sampled < self.cfg.warmup_steps
+        rollouts = ray_tpu.get(
+            [r.sample.remote(params_np, warmup) for r in self.runners],
+            timeout=600)
+        for roll in rollouts:
+            self._return_window.extend(roll.pop("episode_returns").tolist())
+            self.buffer.add_batch(roll)
+            self._steps_sampled += len(roll["obs"])
+        self._return_window = self._return_window[-50:]
+        metrics = {}
+        if not warmup and len(self.buffer) >= self.cfg.batch_size:
+            batches = [self.buffer.sample(self.cfg.batch_size)
+                       for _ in range(self.cfg.updates_per_iteration)]
+            metrics = self.learner.update(batches)
+        return {
+            "episode_return_mean": (float(np.mean(self._return_window))
+                                    if self._return_window else 0.0),
+            "num_env_steps_sampled": self._steps_sampled,
+            "steps_per_sec": (self.cfg.rollout_length
+                              * self.cfg.num_envs_per_runner
+                              * len(self.runners)) / max(time.time() - t0, 1e-6),
+            **metrics,
+        }
+
+    def get_state(self):
+        import jax
+
+        return {"state": jax.tree.map(np.asarray, self.learner.state_tuple())}
+
+    def set_state(self, state):
+        self.learner.load_state_tuple(state["state"])
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -94,24 +94,29 @@ class _ReportHub:
         self.scheduler = cloudpickle.loads(scheduler_blob)
         self.latest: Dict[str, Dict] = {}
         self.iters: Dict[str, int] = {}
+        # report() runs on the actor's thread pool (max_concurrency > 1);
+        # schedulers iterate shared dicts, so serialize their callbacks
+        self._lock = threading.Lock()
 
     def register_trial(self, trial_id: str, config: Dict):
         # PBT needs trial configs for exploit mutation
-        hook = getattr(self.scheduler, "register_trial", None)
-        if hook is not None:
-            hook(trial_id, config)
+        with self._lock:
+            hook = getattr(self.scheduler, "register_trial", None)
+            if hook is not None:
+                hook(trial_id, config)
         return True
 
     def report(self, trial_id: str, metrics: Dict, checkpoint=None):
-        self.iters[trial_id] = self.iters.get(trial_id, 0) + 1
-        metrics = dict(metrics)
-        metrics.setdefault("training_iteration", self.iters[trial_id])
-        self.latest[trial_id] = metrics
-        if checkpoint is not None:
-            hook = getattr(self.scheduler, "record_checkpoint", None)
-            if hook is not None:
-                hook(trial_id, checkpoint)
-        return self.scheduler.on_result(trial_id, metrics)
+        with self._lock:
+            self.iters[trial_id] = self.iters.get(trial_id, 0) + 1
+            metrics = dict(metrics)
+            metrics.setdefault("training_iteration", self.iters[trial_id])
+            self.latest[trial_id] = metrics
+            if checkpoint is not None:
+                hook = getattr(self.scheduler, "record_checkpoint", None)
+                if hook is not None:
+                    hook(trial_id, checkpoint)
+            return self.scheduler.on_result(trial_id, metrics)
 
     def reset_iters(self, trial_id: str):
         """An exploited trial restarts its iteration counter."""
@@ -225,10 +230,12 @@ class Tuner:
                 try:
                     out = ray_tpu.get(ref, timeout=60)
                 except TaskError as e:
-                    results.append(TrialResult(trial_id, cfg, latest,
+                    cfg_clean = {k: v for k, v in cfg.items()
+                                 if k != "__checkpoint__"}
+                    results.append(TrialResult(trial_id, cfg_clean, latest,
                                                error=str(e)[:500]))
                     searcher.on_trial_complete(
-                        trial_id, {**latest, "__config__": cfg})
+                        trial_id, {**latest, "__config__": cfg_clean})
                     continue
                 exploit = out.get("exploit")
                 if exploit is not None:
@@ -241,10 +248,12 @@ class Tuner:
                     continue
                 final = dict(latest)
                 final.update(out.get("metrics") or {})
-                results.append(TrialResult(trial_id, cfg, final,
+                cfg_clean = {k: v for k, v in cfg.items()
+                             if k != "__checkpoint__"}
+                results.append(TrialResult(trial_id, cfg_clean, final,
                                            stopped_early=out.get("stopped",
                                                                  False)))
                 searcher.on_trial_complete(
-                    trial_id, {**final, "__config__": cfg})
+                    trial_id, {**final, "__config__": cfg_clean})
         ray_tpu.kill(hub)
         return ResultGrid(results, tc.metric, tc.mode)
