@@ -137,8 +137,10 @@ def attention(q, k, v, causal: bool = True, impl: str = "auto",
               segment_ids: Optional[jax.Array] = None):
     """Dispatching attention op used by the flagship model."""
     if impl == "auto":
+        from ray_tpu.utils import is_tpu
+
         use_flash = (
-            jax.default_backend() == "tpu"
+            is_tpu()
             and segment_ids is None
             and q.shape[1] % 128 == 0
             and q.shape[-1] in (64, 128, 256)

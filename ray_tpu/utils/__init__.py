@@ -2,4 +2,20 @@
 
 from ray_tpu.utils.jaxtools import import_jax, jax_platform_forced
 
-__all__ = ["import_jax", "jax_platform_forced"]
+__all__ = ["import_jax", "jax_platform_forced", "is_tpu"]
+
+
+def is_tpu() -> bool:
+    """True when jax runs on TPU hardware, including plugin backends whose
+    platform name differs (e.g. a tunneled dev chip): detect by device kind,
+    not backend name. Single source of truth for bench + kernel dispatch."""
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return (jax.default_backend() == "tpu"
+            or "tpu" in str(getattr(dev, "platform", "")).lower()
+            or "tpu" in str(getattr(dev, "device_kind", "")).lower()
+            or "tpu" in str(dev).lower())
