@@ -88,8 +88,8 @@ def _attempt():
         import dataclasses
 
         cfg = dataclasses.replace(cfg, max_seq_len=seq)
-        mesh = create_mesh({"data": 1, "fsdp": 1, "seq": 1, "tensor": 1},
-                           devices=devices[:1])
+        mesh = create_mesh({"data": 1, "fsdp": 1, "seq": 1, "tensor": 1,
+                            "expert": 1}, devices=devices[:1])
         bundle = TrainStepBundle(cfg, mesh, optimizer=make_optimizer(
             learning_rate=1e-4, warmup_steps=10, total_steps=1000))
         params, opt_state = bundle.init(jax.random.PRNGKey(0))
@@ -109,8 +109,7 @@ def _attempt():
 
         tokens_per_step = batch * seq
         tokens_per_sec = tokens_per_step / dt
-        # 6N matmul flops + attention term, per token
-        flops_per_token = 6.0 * cfg.num_params() + 12.0 * cfg.n_layers * cfg.d_model * seq
+        flops_per_token = cfg.flops_per_token()  # 6*N_active + attention
         mfu = tokens_per_sec * flops_per_token / peak
 
         result = {

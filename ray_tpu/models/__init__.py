@@ -1,10 +1,22 @@
-"""Model zoo: the flagship decoder LM plus small nets for RL/vision tests."""
+"""Model zoo: the flagship decoder LM (dense + MoE) plus ViT and RL nets."""
 
 from ray_tpu.models.transformer import (
     CONFIGS,
+    MoEMLP,
     Transformer,
     TransformerConfig,
     lm_loss,
 )
+from ray_tpu.models.vit import (
+    VIT_CONFIGS,
+    VisionTransformer,
+    ViTConfig,
+    accuracy,
+    classification_loss,
+)
 
-__all__ = ["Transformer", "TransformerConfig", "CONFIGS", "lm_loss"]
+__all__ = [
+    "Transformer", "TransformerConfig", "CONFIGS", "MoEMLP", "lm_loss",
+    "VisionTransformer", "ViTConfig", "VIT_CONFIGS",
+    "classification_loss", "accuracy",
+]

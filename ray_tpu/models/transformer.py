@@ -51,6 +51,14 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     attention_impl: str = "auto"  # auto | flash | xla
+    # mixture-of-experts (0 experts = dense MLP); experts shard over the
+    # mesh "expert" axis (EP) and tokens reach them via the one-hot
+    # dispatch einsums XLA lowers to all-to-alls (GShard style)
+    n_experts: int = 0
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
+    moe_every: int = 1  # every Nth block uses MoE (others stay dense)
     tie_embeddings: bool = False
 
     @property
@@ -59,18 +67,38 @@ class TransformerConfig:
 
     def num_params(self) -> int:
         d, f, v = self.d_model, self.d_ff, self.vocab_size
-        per_layer = (
+        attn = (
             d * d  # q
             + 2 * d * (self.n_kv_heads * self.head_dim)  # k, v
             + d * d  # o
-            + 3 * d * f  # gate, up, down
             + 2 * d  # norms
         )
-        return v * d + self.n_layers * per_layer + d + (0 if self.tie_embeddings else d * v)
+        dense_mlp = 3 * d * f
+        total = 0
+        for i in range(self.n_layers):
+            moe = self.n_experts > 0 and i % max(self.moe_every, 1) == 0
+            total += attn + (self.n_experts * 3 * d * f + d * self.n_experts
+                             if moe else dense_mlp)
+        return v * d + total + d + (0 if self.tie_embeddings else d * v)
+
+    def active_params(self) -> int:
+        """Params touched per token: MoE layers count only the
+        experts_per_token experts a token is routed to (MFU accounting)."""
+        if self.n_experts == 0:
+            return self.num_params()
+        d, f = self.d_model, self.d_ff
+        total = self.num_params()
+        for i in range(self.n_layers):
+            if i % max(self.moe_every, 1) == 0:
+                inactive = self.n_experts - self.experts_per_token
+                total -= inactive * 3 * d * f
+        return total
 
     def flops_per_token(self) -> float:
-        """Approximate training FLOPs/token (fwd+bwd ~ 6*N + attention)."""
-        return 6.0 * self.num_params() + 12.0 * self.n_layers * self.d_model * self.max_seq_len
+        """Approximate training FLOPs/token (fwd+bwd ~ 6*N_active +
+        attention)."""
+        return (6.0 * self.active_params()
+                + 12.0 * self.n_layers * self.d_model * self.max_seq_len)
 
 
 # preset configs (name -> config); "tiny" is the CI/test config
@@ -85,6 +113,14 @@ CONFIGS = {
                             n_kv_heads=8, d_ff=5632, max_seq_len=2048),
     "7b": TransformerConfig(vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
                             n_kv_heads=32, d_ff=11008, max_seq_len=4096),
+    "moe-tiny": TransformerConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=128, max_seq_len=128, remat=False, n_experts=4,
+        experts_per_token=2),
+    "moe-1b": TransformerConfig(
+        vocab_size=32000, d_model=1024, n_layers=16, n_heads=16, n_kv_heads=16,
+        d_ff=2816, max_seq_len=2048, n_experts=8, experts_per_token=2,
+        moe_every=2),
 }
 
 
@@ -174,8 +210,104 @@ class MLP(nn.Module):
         )(hidden)
 
 
+class MoEMLP(nn.Module):
+    """Top-k routed mixture-of-experts MLP (GShard-style dense dispatch).
+
+    Reference capability: the reference delegates MoE to vLLM/torch user
+    code; here EP is native — expert-stacked weights carry the "expert"
+    logical axis, the one-hot dispatch/combine einsums keep everything on
+    the MXU, and XLA inserts the expert all-to-alls implied by the
+    shardings. Token capacity is bounded (capacity_factor); overflow
+    tokens fall through the residual (standard token dropping). The
+    load-balancing aux loss is sown under the "losses" collection."""
+
+    cfg: TransformerConfig
+
+    GROUP_SIZE = 4096  # tokens per dispatch group (bounds one-hot memory)
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, S, D = x.shape
+        E, K = cfg.n_experts, cfg.experts_per_token
+        N = B * S
+        # GShard-style grouping: dispatch/combine one-hots are O(g*E*C) per
+        # group with C ~ g*K/E, so memory/FLOPs stay linear in N instead of
+        # quadratic (tokens only compete for capacity within their group)
+        g = N
+        for cand in range(min(self.GROUP_SIZE, N), 0, -1):
+            if N % cand == 0:
+                g = cand
+                break
+        G = N // g
+        C = max(1, int(cfg.capacity_factor * g * K / E))
+        xf = x.reshape(G, g, D)
+
+        router = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="router",
+                          kernel_init=nn.with_logical_partitioning(
+                              nn.initializers.normal(0.02), ("embed", "expert")))
+        logits = router(xf.astype(jnp.float32))  # (G, g, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # top-k expert choice per token
+        gate_vals, expert_idx = jax.lax.top_k(probs, K)  # (G, g, K)
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        # position of each (token, k) within its expert's capacity buffer,
+        # per group; k-slots of a token are ordered before later tokens
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (G, g, K, E)
+        flat = onehot.reshape(G, g * K, E)
+        pos_in_expert = (jnp.cumsum(flat, axis=1) - flat).reshape(G, g, K, E)
+        pos = (pos_in_expert * onehot).sum(-1)  # (G, g, K)
+        keep = pos < C
+
+        # dispatch/combine (G, g, E, C)
+        eh = jax.nn.one_hot(expert_idx, E, dtype=cfg.dtype)[..., None]
+        ph = jax.nn.one_hot(pos, C, dtype=cfg.dtype)[..., None, :]
+        dispatch = (eh * ph * keep[..., None, None].astype(cfg.dtype)).sum(2)
+        combine = (eh * ph
+                   * (gate_vals * keep)[..., None, None].astype(cfg.dtype)).sum(2)
+
+        expert_in = jnp.einsum("gnec,gnd->gecd", dispatch, xf)
+        expert_in = nn.with_logical_constraint(
+            expert_in, (None, "expert", None, "embed"))
+
+        def stack_param(name, shape, axes):
+            return self.param(
+                name, nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02 / np.sqrt(2 * cfg.n_layers)),
+                    axes),
+                shape, cfg.param_dtype)
+
+        w_gate = stack_param("gate_proj", (E, D, cfg.d_ff),
+                             ("expert", "embed", "mlp"))
+        w_up = stack_param("up_proj", (E, D, cfg.d_ff),
+                           ("expert", "embed", "mlp"))
+        w_down = stack_param("down_proj", (E, cfg.d_ff, D),
+                             ("expert", "mlp", "embed"))
+        h = (nn.silu(jnp.einsum("gecd,edf->gecf", expert_in,
+                                w_gate.astype(cfg.dtype)))
+             * jnp.einsum("gecd,edf->gecf", expert_in, w_up.astype(cfg.dtype)))
+        expert_out = jnp.einsum("gecf,efd->gecd", h, w_down.astype(cfg.dtype))
+        expert_out = nn.with_logical_constraint(
+            expert_out, (None, "expert", None, "embed"))
+        out = jnp.einsum("gnec,gecd->gnd", combine, expert_out)
+
+        # load-balancing loss (Switch/GShard): E * sum_e f_e * p_e
+        token_frac = jnp.mean(
+            jax.nn.one_hot(expert_idx[..., 0], E, dtype=jnp.float32),
+            axis=(0, 1))
+        prob_frac = jnp.mean(probs, axis=(0, 1))
+        aux = E * jnp.sum(token_frac * prob_frac)
+        self.sow("losses", "moe_aux", aux)
+        return out.reshape(B, S, D)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
@@ -183,7 +315,8 @@ class Block(nn.Module):
         h = x + Attention(cfg, name="attn")(
             RMSNorm(dtype=cfg.dtype, name="attn_norm")(x), positions, segment_ids)
         h = nn.with_logical_constraint(h, ("batch", "seq", "embed"))
-        out = h + MLP(cfg, name="mlp")(RMSNorm(dtype=cfg.dtype, name="mlp_norm")(h))
+        mlp = MoEMLP(cfg, name="moe") if self.use_moe else MLP(cfg, name="mlp")
+        out = h + mlp(RMSNorm(dtype=cfg.dtype, name="mlp_norm")(h))
         return nn.with_logical_constraint(out, ("batch", "seq", "embed"))
 
 
@@ -209,7 +342,9 @@ class Transformer(nn.Module):
             block = nn.remat(Block, prevent_cse=False,
                              policy=jax.checkpoint_policies.nothing_saveable)
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions, segment_ids)
+            use_moe = cfg.n_experts > 0 and i % max(cfg.moe_every, 1) == 0
+            x = block(cfg, use_moe, name=f"layer_{i}")(
+                x, positions, segment_ids)
         x = RMSNorm(dtype=cfg.dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(cfg.dtype))

@@ -1,0 +1,108 @@
+"""Vision Transformer classifier (second model family).
+
+Reference capability: the reference trains vision models through torch in
+user code (rllib CNNs, train examples); here the ViT is framework-native
+flax with the same logical sharding vocabulary as the LM — patch/TP
+shardings resolve against any mesh, so DP/FSDP/TP apply unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    num_classes: int = 10
+    d_model: int = 192
+    n_layers: int = 6
+    n_heads: int = 6
+    d_ff: int = 768
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+VIT_CONFIGS = {
+    "vit-tiny": ViTConfig(),
+    "vit-s16-224": ViTConfig(image_size=224, patch_size=16, num_classes=1000,
+                             d_model=384, n_layers=12, n_heads=6, d_ff=1536),
+    "vit-b16-224": ViTConfig(image_size=224, patch_size=16, num_classes=1000,
+                             d_model=768, n_layers=12, n_heads=12, d_ff=3072),
+}
+
+
+class EncoderBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.n_heads, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            deterministic=deterministic, name="attn")(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="fc1",
+                     kernel_init=nn.with_logical_partitioning(
+                         nn.initializers.xavier_uniform(), ("embed", "mlp")))(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="fc2",
+                     kernel_init=nn.with_logical_partitioning(
+                         nn.initializers.xavier_uniform(), ("mlp", "embed")))(h)
+        return x + h
+
+
+class VisionTransformer(nn.Module):
+    """(B, H, W, C) images -> (B, num_classes) logits."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, deterministic: bool = True):
+        cfg = self.cfg
+        B = images.shape[0]
+        x = nn.Conv(cfg.d_model, kernel_size=(cfg.patch_size, cfg.patch_size),
+                    strides=(cfg.patch_size, cfg.patch_size),
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="patch_embed")(images.astype(cfg.dtype))
+        x = x.reshape(B, -1, cfg.d_model)  # (B, P, D)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.d_model), cfg.param_dtype)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(cfg.dtype), (B, 1, cfg.d_model)), x],
+            axis=1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, cfg.num_patches + 1, cfg.d_model), cfg.param_dtype)
+        x = x + pos.astype(cfg.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "seq", "embed"))
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_final")(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        param_dtype=cfg.param_dtype, name="head")(x[:, 0])
+
+
+def classification_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return (jnp.argmax(logits, -1) == labels).mean()

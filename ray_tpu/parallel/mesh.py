@@ -23,7 +23,7 @@ import numpy as np
 
 from ray_tpu.utils import import_jax
 
-AXES = ("data", "fsdp", "seq", "tensor")
+AXES = ("data", "fsdp", "seq", "tensor", "expert")
 
 # logical axis -> mesh axis (or tuple) mapping; None = replicated
 LOGICAL_RULES = (
@@ -63,7 +63,8 @@ def default_mesh_axes(n_devices: int) -> Dict[str, int]:
             break
     if n_devices <= 4:
         tensor = 1
-    return {"data": 1, "fsdp": n_devices // tensor, "seq": 1, "tensor": tensor}
+    return {"data": 1, "fsdp": n_devices // tensor, "seq": 1, "tensor": tensor,
+            "expert": 1}
 
 
 def logical_to_mesh_sharding(logical_spec_tree, mesh, rules=LOGICAL_RULES):

@@ -64,8 +64,11 @@ class TrainStepBundle:
         self.init = jax.jit(init_fn, out_shardings=shardings)
 
         def loss_fn(params, tokens, targets, mask):
-            logits = self.model.apply({"params": params}, tokens)
-            return lm_loss(logits, targets, mask)
+            # "losses" is valid for dense models too (empty -> aux sums to 0)
+            logits, cols = self.model.apply(
+                {"params": params}, tokens, mutable=["losses"])
+            aux = sum(jax.tree.leaves(cols.get("losses", {})))
+            return lm_loss(logits, targets, mask) + cfg.moe_aux_coef * aux
 
         def train_step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(
@@ -88,7 +91,8 @@ class TrainStepBundle:
         )
 
         def eval_step(params, batch):
-            logits = self.model.apply({"params": params}, batch["tokens"])
+            logits, _ = self.model.apply(
+                {"params": params}, batch["tokens"], mutable=["losses"])
             return lm_loss(logits, batch["targets"], batch.get("mask"))
 
         self.eval_step = jax.jit(eval_step)
