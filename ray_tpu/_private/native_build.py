@@ -1,0 +1,61 @@
+"""Shared g++ build-on-first-use helper for the native components
+(arena store, data loader). Rebuilds when the source is newer than the
+cached .so; a corrupt/foreign .so falls back to rebuild, then to None so
+callers can use their Python fallbacks."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def build_and_load(src: str, lib_path: str,
+                   extra_flags: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
+    with _lock:
+        key = lib_path
+        if key in _cache:
+            return _cache[key]
+
+        def _build() -> bool:
+            os.makedirs(os.path.dirname(lib_path), exist_ok=True)
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                   *extra_flags, src, "-o", lib_path + ".tmp"]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+                os.replace(lib_path + ".tmp", lib_path)
+                return True
+            except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                    OSError):
+                return False
+
+        def _stale() -> bool:
+            try:
+                return os.path.getmtime(src) > os.path.getmtime(lib_path)
+            except OSError:
+                return True
+
+        lib = None
+        if not os.path.exists(lib_path) or _stale():
+            _build()
+        if os.path.exists(lib_path):
+            try:
+                lib = ctypes.CDLL(lib_path)
+            except OSError:
+                # corrupt or wrong-arch artifact: rebuild once
+                try:
+                    os.unlink(lib_path)
+                except OSError:
+                    pass
+                if _build():
+                    try:
+                        lib = ctypes.CDLL(lib_path)
+                    except OSError:
+                        lib = None
+        _cache[key] = lib
+        return lib

@@ -1,0 +1,168 @@
+"""Native token-file loader for training input pipelines.
+
+ctypes wrapper over ``src/data_loader/loader.cc`` (built with g++ on first
+use, like the arena store): a background C++ thread samples batches of
+``seq+1`` consecutive tokens from an mmap'd corpus into a ring of
+buffers; Python hands zero-copy int32 views to ``jax.device_put`` and
+releases the slot. Falls back to a numpy memmap implementation when the
+native build is unavailable (same API, same seeded sampling).
+
+Usage::
+
+    loader = TokenFileLoader("corpus.bin", batch=8, seq=2048, seed=0)
+    for batch in loader.batches():        # {"tokens", "targets", "mask"}
+        params, opt, loss = bundle.step(params, opt, device_put(batch))
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "src", "data_loader", "loader.cc")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "build")
+_LIB = os.path.join(_BUILD_DIR, "libray_tpu_loader.so")
+
+_lock = threading.Lock()
+_lib = None
+_typed = False
+
+
+def load_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _typed
+    from ray_tpu._private.native_build import build_and_load
+
+    with _lock:
+        if _typed:
+            return _lib
+        lib = build_and_load(_SRC, _LIB, extra_flags=("-pthread",))
+        _typed = True
+        if lib is None:
+            _lib = None
+            return None
+        lib.dl_create.restype = ctypes.c_void_p
+        lib.dl_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_int64, ctypes.c_uint64,
+                                  ctypes.c_int, ctypes.c_int]
+        lib.dl_next.restype = ctypes.c_int
+        lib.dl_next.argtypes = [ctypes.c_void_p]
+        lib.dl_buffer.restype = ctypes.POINTER(ctypes.c_int32)
+        lib.dl_buffer.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dl_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.dl_num_tokens.restype = ctypes.c_int64
+        lib.dl_num_tokens.argtypes = [ctypes.c_void_p]
+        lib.dl_batches_produced.restype = ctypes.c_int64
+        lib.dl_batches_produced.argtypes = [ctypes.c_void_p]
+        lib.dl_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class TokenFileLoader:
+    """Double-buffered sampling loader over a binary token file."""
+
+    def __init__(self, path: str, batch: int, seq: int, seed: int = 0,
+                 n_buffers: int = 3, token_bytes: int = 4,
+                 force_python: bool = False):
+        self.path = path
+        self.batch = batch
+        self.seq = seq
+        self.seed = seed
+        self.token_bytes = token_bytes
+        self._handle = None
+        self._lib = None if force_python else load_lib()
+        self.native = False
+        if self._lib is not None:
+            handle = self._lib.dl_create(path.encode(), batch, seq, seed or 1,
+                                         n_buffers, token_bytes)
+            if handle:
+                self._handle = ctypes.c_void_p(handle)
+                self.native = True
+        if not self.native:  # pure-python fallback (same sampling scheme)
+            dtype = np.uint16 if token_bytes == 2 else np.int32
+            self._mm = np.memmap(path, dtype=dtype, mode="r")
+            self._rng_state = np.uint64(seed or 1)
+
+    @property
+    def num_tokens(self) -> int:
+        if self.native:
+            return int(self._lib.dl_num_tokens(self._handle))
+        return int(len(self._mm))
+
+    def _xorshift(self) -> int:
+        s = int(self._rng_state)
+        s ^= (s << 13) & 0xFFFFFFFFFFFFFFFF
+        s ^= s >> 7
+        s ^= (s << 17) & 0xFFFFFFFFFFFFFFFF
+        self._rng_state = np.uint64(s)
+        return s
+
+    def next_batch(self) -> Dict[str, np.ndarray]:
+        """One {"tokens","targets","mask"} batch. The returned arrays are
+        valid until the NEXT call (they view the native ring buffer) — copy
+        or device_put before advancing."""
+        row = self.seq + 1
+        if self.native:
+            # release BEFORE blocking on the next slot: with a single
+            # buffer, holding it while waiting would deadlock the ring
+            if getattr(self, "_held", None) is not None:
+                self._lib.dl_release(self._handle, self._held)
+                self._held = None
+            slot = self._lib.dl_next(self._handle)
+            if slot < 0:
+                raise RuntimeError("loader stopped")
+            self._held = slot
+            ptr = self._lib.dl_buffer(self._handle, slot)
+            arr = np.ctypeslib.as_array(ptr, shape=(self.batch, row))
+        else:
+            max_start = self.num_tokens - row
+            arr = np.empty((self.batch, row), np.int32)
+            for b in range(self.batch):
+                start = self._xorshift() % (max_start + 1) if max_start > 0 else 0
+                arr[b] = self._mm[start:start + row].astype(np.int32)
+        return {
+            "tokens": arr[:, :-1],
+            "targets": arr[:, 1:],
+            "mask": np.ones((self.batch, self.seq), np.float32),
+        }
+
+    def batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            yield self.next_batch()
+
+    def batches_produced(self) -> int:
+        if self.native:
+            return int(self._lib.dl_batches_produced(self._handle))
+        return 0
+
+    def close(self):
+        if self.native and self._handle is not None:
+            if getattr(self, "_held", None) is not None:
+                self._lib.dl_release(self._handle, self._held)
+                self._held = None
+            self._lib.dl_destroy(self._handle)
+            self._handle = None
+            self.native = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def write_token_file(path: str, tokens: np.ndarray, token_bytes: int = 4):
+    """Helper: dump a 1-D token array in the loader's format."""
+    dtype = np.uint16 if token_bytes == 2 else np.int32
+    np.asarray(tokens, dtype=dtype).tofile(path)
