@@ -1156,9 +1156,25 @@ class CoreWorker:
         fn = await self._fetch_function(spec.function_key)
         args, kwargs = await self._resolve_args(spec.args_blob)
         self._ensure_pool(1)
+        t0 = time.time()
         result, err = await self.loop.run_in_executor(
             self._exec_pool, self._call_user_fn, fn, args, kwargs, spec)
+        self._trace_task(spec, getattr(fn, "__name__", "task"), t0, err)
         return await self._pack_results(spec, result, err)
+
+    def _trace_task(self, spec: TaskSpec, name: str, t0: float, err):
+        """Span per executed task (reference: profile_event.cc into the
+        task event buffer); no-op unless tracing is enabled."""
+        from ray_tpu.util import tracing
+
+        if not tracing.enabled():
+            return
+        if spec.actor_id is not None and spec.method_name:
+            name = f"{type(self.actor_instance).__name__}.{spec.method_name}"                 if self.actor_instance is not None else spec.method_name
+        tracing.record_span(
+            name, t0, time.time(),
+            category="actor_task" if spec.actor_id is not None else "task",
+            task_id=spec.task_id.hex(), ok=err is None)
 
     def _call_user_fn(self, fn, args, kwargs, spec: TaskSpec):
         self._tls.task_id = spec.task_id
@@ -1282,6 +1298,7 @@ class CoreWorker:
         if transport == "object":
             transport = ""
         args, kwargs = await self._resolve_args(spec.args_blob)
+        t0 = time.time()
         if asyncio.iscoroutinefunction(method):
             async with self._actor_sem:
                 try:
@@ -1291,6 +1308,7 @@ class CoreWorker:
         else:
             result, err = await self.loop.run_in_executor(
                 self._exec_pool, self._call_user_fn, method, args, kwargs, spec)
+        self._trace_task(spec, spec.method_name, t0, err)
         return await self._pack_results(spec, result, err, transport=transport)
 
     # ------------------------------------------------------------------
@@ -1301,6 +1319,13 @@ class CoreWorker:
         if self._shutdown:
             return
         self._shutdown = True
+        try:
+            from ray_tpu.util import tracing
+
+            if tracing.enabled():
+                tracing.flush()
+        except Exception:
+            pass
 
         async def _close():
             for pool in self._lease_cache.values():

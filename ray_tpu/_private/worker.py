@@ -309,6 +309,16 @@ def init(
             # job-level default: merged into every task/actor whose options
             # don't set their own runtime_env
             _global_worker.job_runtime_env = normalize(runtime_env)
+        from ray_tpu.util import tracing as _tracing
+
+        if _tracing.enabled():
+            # tracing must reach workers on pre-started clusters too: ride
+            # the job runtime env (raylet merges env_vars into worker spawns)
+            renv = dict(_global_worker.job_runtime_env or {})
+            env_vars = dict(renv.get("env_vars") or {})
+            env_vars.setdefault("RAY_TPU_ENABLE_TRACING", "1")
+            renv["env_vars"] = env_vars
+            _global_worker.job_runtime_env = renv
         return _global_worker
 
 

@@ -100,6 +100,16 @@ def cmd_job(args):
         print(client.stop_job(args.submission_id))
 
 
+def cmd_timeline(args):
+    """ray-tpu timeline: export a chrome://tracing JSON of task spans
+    (reference: `ray timeline`)."""
+    _connect(args)
+    from ray_tpu.util import tracing
+
+    n = tracing.export_chrome_trace(args.out)
+    print(f"wrote {n} events to {args.out} (open in chrome://tracing)")
+
+
 def cmd_microbenchmark(args):
     import ray_tpu
 
@@ -147,6 +157,10 @@ def main(argv=None):
         jp.add_argument("submission_id")
     jsub.add_parser("list")
     p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("timeline", help="export chrome://tracing task timeline")
+    p.add_argument("--out", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("microbenchmark", help="run the core perf suite")
     p.add_argument("--duration", type=float, default=2.0)

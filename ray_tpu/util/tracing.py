@@ -1,0 +1,220 @@
+"""Task tracing + profile events with chrome://tracing export.
+
+Reference: the reference captures per-task profile events in C++
+(``core_worker/profile_event.cc``) into a ``TaskEventBuffer``
+(``task_event_buffer.cc``) that flushes to the GCS ``GcsTaskManager`` and
+feeds the dashboard timeline; opt-in OpenTelemetry spans wrap remote calls
+(``util/tracing/tracing_helper.py:326``). Here every worker buffers span
+records and flushes them to the GCS KV (``trace`` namespace); the driver
+gathers them with :func:`get_spans` and writes a chrome://tracing JSON
+timeline with :func:`export_chrome_trace` (also ``ray-tpu timeline``).
+
+Enable with ``RAY_TPU_ENABLE_TRACING=1`` (on the driver: before init — the
+flag propagates to workers through the runtime env) or per-session via
+``ray_tpu.util.tracing.enable()``. User code can add custom spans::
+
+    with ray_tpu.util.tracing.profile("tokenize"):
+        ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import pickle
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_buffer: List[dict] = []
+_flush_counter = 0
+_enabled: Optional[bool] = None
+
+_FLUSH_EVERY = 32
+_FLUSH_INTERVAL_S = 1.0
+_MAX_BUFFER = 10_000  # drop-oldest beyond this: tracing never leaks unbounded
+_last_flush = time.time()
+_timer: Optional[threading.Timer] = None
+# cluster-unique flush-key tag (pids collide across nodes/restarts)
+_proc_tag = uuid.uuid4().hex[:10]
+
+
+def enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get("RAY_TPU_ENABLE_TRACING", "") in ("1", "true")
+    return _enabled
+
+
+def enable():
+    global _enabled
+    os.environ["RAY_TPU_ENABLE_TRACING"] = "1"
+    _enabled = True
+
+
+def record_span(name: str, start_s: float, end_s: float,
+                category: str = "task", **extra):
+    """Buffer one span; flushes to the GCS every _FLUSH_EVERY spans."""
+    if not enabled():
+        return
+    span = {
+        "name": name,
+        "cat": category,
+        "ts": start_s,
+        "dur": end_s - start_s,
+        "pid": os.getpid(),
+        "tid": threading.get_ident() % 100_000,
+        **extra,
+    }
+    global _timer
+    flush_now = False
+    with _lock:
+        _buffer.append(span)
+        if len(_buffer) > _MAX_BUFFER:
+            del _buffer[: len(_buffer) - _MAX_BUFFER]
+        if len(_buffer) >= _FLUSH_EVERY:
+            # size-triggered flushes are synchronous (backpressure);
+            # time-triggered ones run on the timer thread so sporadic user
+            # spans never pay a GCS round-trip inline
+            flush_now = True
+        elif _timer is None:
+            _timer = threading.Timer(_FLUSH_INTERVAL_S, _timer_flush)
+            _timer.daemon = True
+            _timer.start()
+    if flush_now:
+        flush()
+
+
+def _timer_flush():
+    global _timer
+    with _lock:
+        _timer = None
+    flush()
+
+
+@contextlib.contextmanager
+def profile(name: str, category: str = "user", **extra):
+    """Custom user span (reference: ray.util.tracing via profile events)."""
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        record_span(name, t0, time.time(), category=category, **extra)
+
+
+def flush():
+    """Push buffered spans to the GCS KV; safe to call anywhere."""
+    global _flush_counter, _last_flush
+    with _lock:
+        _last_flush = time.time()
+        if not _buffer:
+            return
+        spans, _buffer[:] = list(_buffer), []
+        _flush_counter += 1
+        counter = _flush_counter
+    def _rebuffer():
+        with _lock:
+            _buffer[:0] = spans
+            if len(_buffer) > _MAX_BUFFER:
+                del _buffer[: len(_buffer) - _MAX_BUFFER]
+
+    try:
+        from ray_tpu._private.worker import global_worker, is_initialized
+
+        if not is_initialized():
+            _rebuffer()  # pre-init spans surface after init
+            return
+        core = global_worker()
+        if getattr(core, "mode", "") == "local":
+            # local mode: keep spans in-process (get_spans reads them back)
+            _local_spans.extend(spans)
+            return
+        req = {"ns": "trace", "key": f"spans_{_proc_tag}_{counter}",
+               "value": pickle.dumps(spans)}
+
+        async def _put_guarded():
+            try:
+                await core._gcs_call("KVPut", req)
+            except Exception:
+                _rebuffer()
+
+        try:
+            import asyncio
+
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is not None and running is core.loop:
+            # called from the worker's event loop (task-execution path):
+            # blocking would deadlock — fire and forget, re-buffer on error
+            asyncio.ensure_future(_put_guarded())
+        else:
+            core._run(_put_guarded())
+    except Exception:
+        # tracing must never take down the workload
+        _rebuffer()
+
+
+_local_spans: List[dict] = []
+
+
+def get_spans() -> List[dict]:
+    """Gather all spans recorded so far, cluster-wide."""
+    flush()
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker()
+    if getattr(core, "mode", "") == "local":
+        return list(_local_spans)
+    keys = core._run(core._gcs_call(
+        "KVKeys", {"ns": "trace", "prefix": "spans_"}))["keys"]
+    out: List[dict] = []
+    for key in keys:
+        blob = core._run(core._gcs_call(
+            "KVGet", {"ns": "trace", "key": key}))["value"]
+        if blob:
+            out.extend(pickle.loads(blob))
+    return sorted(out, key=lambda s: s["ts"])
+
+
+def clear():
+    """Delete all collected spans (GCS trace table + local buffers)."""
+    global _local_spans
+    with _lock:
+        _buffer.clear()
+    _local_spans = []
+    from ray_tpu._private.worker import global_worker, is_initialized
+
+    if not is_initialized():
+        return
+    core = global_worker()
+    if getattr(core, "mode", "") == "local":
+        return
+    core._run(core._gcs_call("KVDel", {"ns": "trace", "key": "spans_",
+                                       "prefix": True}))
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write a chrome://tracing (about://tracing, Perfetto) JSON file.
+    Returns the number of events written."""
+    spans = get_spans()
+    events = [
+        {
+            "name": s["name"],
+            "cat": s.get("cat", "task"),
+            "ph": "X",
+            "ts": s["ts"] * 1e6,  # microseconds
+            "dur": max(s["dur"], 0.0) * 1e6,
+            "pid": s.get("pid", 0),
+            "tid": s.get("tid", 0),
+            "args": {k: v for k, v in s.items()
+                     if k not in ("name", "cat", "ts", "dur", "pid", "tid")},
+        }
+        for s in spans
+    ]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return len(events)

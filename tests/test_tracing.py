@@ -1,0 +1,88 @@
+"""Tracing/profile-event tests (reference tier: task events -> GCS ->
+timeline; util/tracing)."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(scope="module")
+def traced_cluster():
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_ENABLE_TRACING"] = "1"
+    tracing._enabled = None  # re-read the flag
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_ENABLE_TRACING", None)
+    tracing._enabled = None
+
+
+def test_task_and_actor_spans_collected(traced_cluster):
+    @ray_tpu.remote
+    def traced_fn(x):
+        with tracing.profile("inner_work", detail="custom"):
+            return x + 1
+
+    @ray_tpu.remote
+    class Actor:
+        def ping(self):
+            return "pong"
+
+    assert ray_tpu.get(traced_fn.remote(1), timeout=60) == 2
+    a = Actor.options(num_cpus=0.1).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+    import time
+
+    deadline = time.monotonic() + 30
+    spans = []
+    while time.monotonic() < deadline:
+        spans = tracing.get_spans()
+        names = {s["name"] for s in spans}
+        if "traced_fn" in names and "Actor.ping" in names \
+                and "inner_work" in names:
+            break
+        time.sleep(0.5)
+    names = {s["name"] for s in spans}
+    assert "traced_fn" in names, names
+    assert "Actor.ping" in names, names
+    assert "inner_work" in names, names
+    cats = {s["name"]: s["cat"] for s in spans}
+    assert cats["traced_fn"] == "task"
+    assert cats["Actor.ping"] == "actor_task"
+    assert cats["inner_work"] == "user"
+
+
+def test_chrome_trace_export(traced_cluster, tmp_path):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(3)], timeout=60)
+    out = str(tmp_path / "trace.json")
+    import time
+
+    deadline = time.monotonic() + 30
+    n = 0
+    while time.monotonic() < deadline:
+        n = tracing.export_chrome_trace(out)
+        if n >= 3:
+            break
+        time.sleep(0.5)
+    assert n >= 3
+    data = json.load(open(out))
+    ev = data["traceEvents"][0]
+    assert ev["ph"] == "X" and "ts" in ev and "dur" in ev
+
+
+def test_disabled_is_noop():
+    tracing._enabled = None
+    os.environ.pop("RAY_TPU_ENABLE_TRACING", None)
+    t0 = len(tracing._buffer)
+    tracing.record_span("ignored", 0.0, 1.0)
+    assert len(tracing._buffer) == t0
