@@ -282,6 +282,24 @@ def init(
 
             for k, v in _system_config.items():
                 os.environ[f"RAY_TPU_{k.upper()}"] = str(v)
+        if address and (address.startswith("ray-tpu://")
+                        or address.startswith("ray_tpu://")):
+            # client mode: thin external client -> in-cluster proxy
+            # (reference: ray:// via python/ray/util/client)
+            import logging as _logging
+
+            unsupported = {"runtime_env": runtime_env, "num_cpus": num_cpus,
+                           "num_tpus": num_tpus, "resources": resources,
+                           "local_mode": local_mode or None}
+            dropped = [k for k, v in unsupported.items() if v]
+            if dropped:
+                _logging.getLogger("ray_tpu").warning(
+                    "client mode ignores init() options %s — set them on "
+                    "the cluster/proxy side", dropped)
+            from ray_tpu.util.client.client import ClientWorker
+
+            _global_worker = ClientWorker(address, namespace=namespace)
+            return _global_worker
         if local_mode:
             if runtime_env and runtime_env.get("env_vars"):
                 import os

@@ -32,6 +32,23 @@ def cmd_start(args):
     if args.include_dashboard:
         dash = supervisor.start_dashboard(port=args.dashboard_port)
         print(f"dashboard at http://{dash}")
+    if args.client_server_port:
+        import threading
+
+        import ray_tpu
+        from ray_tpu.util.client import start_client_server
+
+        ray_tpu.init(address=address)
+
+        def _serve_clients():
+            try:
+                start_client_server(port=args.client_server_port)
+            except BaseException as e:  # surface bind failures
+                print(f"client server FAILED: {e}", file=sys.stderr)
+
+        threading.Thread(target=_serve_clients, daemon=True).start()
+        print(f"client endpoint: ray-tpu://<this-host>:{args.client_server_port} "
+              "(watch for the 'listening on' line)")
     print("press Ctrl-C to stop")
     try:
         signal.pause()
@@ -136,6 +153,8 @@ def main(argv=None):
     p.add_argument("--labels", default="")
     p.add_argument("--include-dashboard", action="store_true")
     p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument("--client-server-port", type=int, default=0,
+                   help="serve a ray-tpu:// client endpoint on this port")
     p.set_defaults(fn=cmd_start)
 
     p = sub.add_parser("status", help="cluster summary")

@@ -1,0 +1,3 @@
+from ray_tpu.util.client.server import ClientProxyServer, start_client_server
+
+__all__ = ["ClientProxyServer", "start_client_server"]

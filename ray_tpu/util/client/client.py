@@ -1,0 +1,220 @@
+"""Thin external client for ``ray-tpu://`` addresses.
+
+Reference: python/ray/util/client/worker.py — the client-mode Worker that
+ships pickled operations to the in-cluster proxy and wraps returned ids
+as refs/handles. ``ray_tpu.init(address="ray-tpu://host:port")`` installs
+a :class:`ClientWorker` as the global worker; the whole public API
+(remote/get/put/wait/actors) works unchanged from outside the cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._private.rpc import RetryingRpcClient
+from ray_tpu.exceptions import TaskError
+from ray_tpu.object_ref import ObjectRef
+
+
+def _options_dict(opts) -> Dict[str, Any]:
+    """Non-default dataclass fields -> kwargs for .options() on the proxy."""
+    out = {}
+    for f in dataclasses.fields(opts):
+        value = getattr(opts, f.name)
+        if f.default is not dataclasses.MISSING:
+            default = f.default
+        elif f.default_factory is not dataclasses.MISSING:  # type: ignore
+            default = f.default_factory()  # type: ignore
+        else:
+            default = None
+        if value != default:
+            out[f.name] = value
+    return out
+
+
+class ClientWorker:
+    """Global-worker implementation that proxies everything over one TCP
+    connection to an in-cluster ClientProxyServer."""
+
+    mode = "client"
+
+    def __init__(self, address: str, namespace: str = "default"):
+        import uuid
+
+        # ray-tpu://host:port
+        hostport = address.split("://", 1)[1]
+        self.address = hostport
+        self.namespace = namespace
+        self.job_id = JobID.from_int(1)
+        # session id rides every request so a transparent reconnect resumes
+        # the same proxy-side session (refs/actors survive TCP blips)
+        self.session_id = f"client_{uuid.uuid4().hex[:12]}"
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self.loop.run_forever, daemon=True, name="ray-tpu-client")
+        self._thread.start()
+        self.client = RetryingRpcClient(hostport)
+        try:
+            self._call("Ping", {}, timeout=30.0)
+        except BaseException:
+            # don't leak the loop thread when the endpoint is unreachable
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._thread.join(timeout=5)
+            raise
+
+    # -- plumbing ------------------------------------------------------
+
+    def _call(self, method: str, req: dict, timeout: Optional[float] = None):
+        import pickle
+
+        req = dict(req, session=self.session_id)
+        fut = asyncio.run_coroutine_threadsafe(
+            self.client.call(method, pickle.dumps(req),
+                             timeout=timeout or 300.0), self.loop)
+        return pickle.loads(fut.result(timeout=(timeout or 300.0) + 30))
+
+    @staticmethod
+    def _marker_args(args, kwargs) -> bytes:
+        from ray_tpu.util.client.server import _RefMarker
+
+        def fix(v):
+            if isinstance(v, ObjectRef):
+                return _RefMarker(v.binary())
+            return v
+
+        return cloudpickle.dumps(
+            ([fix(a) for a in args], {k: fix(v) for k, v in kwargs.items()}))
+
+    @staticmethod
+    def _mk_refs(binaries: List[bytes]) -> List[ObjectRef]:
+        return [ObjectRef(ObjectID(b)) for b in binaries]
+
+    # -- objects -------------------------------------------------------
+
+    def put(self, value: Any) -> ObjectRef:
+        reply = self._call("Put", {"blob": cloudpickle.dumps(value)})
+        return ObjectRef(ObjectID(reply["ref"]))
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        ref_list = [refs] if single else list(refs)
+        reply = self._call("Get", {
+            "refs": [r.binary() for r in ref_list],
+            "timeout": timeout,
+        }, timeout=(timeout or 86400.0) + 10)
+        if reply["status"] == "error":
+            raise cloudpickle.loads(reply["error"])
+        values = cloudpickle.loads(reply["blob"])
+        return values[0] if single else values
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        reply = self._call("Wait", {
+            "refs": [r.binary() for r in refs],
+            "num_returns": num_returns, "timeout": timeout,
+        }, timeout=(timeout or 300.0) + 10)
+        by_id = {r.binary(): r for r in refs}
+        return ([by_id[b] for b in reply["ready"]],
+                [by_id[b] for b in reply["pending"]])
+
+    def free_objects(self, refs):
+        pass  # proxy reaps on disconnect
+
+    # -- tasks ---------------------------------------------------------
+
+    def submit_task(self, remote_fn, args, kwargs, opts):
+        blob = cloudpickle.dumps(remote_fn.function)
+        fn_hash = hashlib.sha1(blob).hexdigest()
+        reply = self._call("SubmitTask", {
+            "fn_hash": fn_hash, "fn_blob": blob,
+            "args_blob": self._marker_args(args, kwargs),
+            "options": _options_dict(opts),
+        })
+        refs = self._mk_refs(reply["refs"])
+        return refs[0] if len(refs) == 1 else refs
+
+    # -- actors --------------------------------------------------------
+
+    def create_actor(self, actor_cls, args, kwargs, opts):
+        from ray_tpu.actor import ActorHandle
+
+        blob = cloudpickle.dumps(actor_cls.cls)
+        cls_hash = hashlib.sha1(blob).hexdigest()
+        reply = self._call("CreateActor", {
+            "cls_hash": cls_hash, "cls_blob": blob,
+            "args_blob": self._marker_args(args, kwargs),
+            "options": _options_dict(opts),
+        })
+        return ActorHandle(ActorID(reply["actor_id"]), reply["methods"],
+                           reply["class_name"])
+
+    def submit_actor_task(self, handle, method_name, args, kwargs,
+                          num_returns=1, tensor_transport=""):
+        options = {}
+        if num_returns != 1:
+            options["num_returns"] = num_returns
+        if tensor_transport:
+            options["tensor_transport"] = tensor_transport
+        reply = self._call("SubmitActorTask", {
+            "actor_id": handle.actor_id.binary(), "method": method_name,
+            "args_blob": self._marker_args(args, kwargs),
+            "options": options,
+        })
+        refs = self._mk_refs(reply["refs"])
+        return refs[0] if len(refs) == 1 else refs
+
+    def get_actor(self, name: str, namespace: Optional[str] = None):
+        from ray_tpu.actor import ActorHandle
+
+        reply = self._call("GetActor", {"name": name,
+                                        "namespace": namespace or self.namespace})
+        return ActorHandle(ActorID(reply["actor_id"]), reply["methods"],
+                           reply["class_name"])
+
+    def kill_actor(self, handle, no_restart=True):
+        self._call("KillActor", {"actor_id": handle.actor_id.binary(),
+                                 "no_restart": no_restart})
+
+    def cancel(self, ref, force=False, recursive=True):
+        pass
+
+    # -- cluster info --------------------------------------------------
+
+    def cluster_resources(self):
+        return self._call("ClusterInfo", {})["cluster_resources"]
+
+    def available_resources(self):
+        return self._call("ClusterInfo", {})["available_resources"]
+
+    def nodes(self):
+        return self._call("ClusterInfo", {})["nodes"]
+
+    # -- futures (rarely used from external clients) -------------------
+
+    def as_future(self, ref):
+        import concurrent.futures
+
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        try:
+            fut.set_result(self.get(ref))
+        except Exception as e:
+            fut.set_exception(e)
+        return fut
+
+    async def await_ref(self, ref):
+        return self.get(ref)
+
+    def shutdown(self):
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.client.close(), self.loop).result(timeout=5)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)

@@ -1,0 +1,94 @@
+"""Client-mode (ray-tpu://) tests (reference tier: util/client tests)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def client_cluster(tmp_path_factory):
+    """A cluster + client proxy in a separate process; this test process
+    connects only through ray-tpu:// (a true external client)."""
+    ray_tpu.shutdown()
+    tmp = tmp_path_factory.mktemp("client")
+    script = tmp / "host.py"
+    script.write_text(
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import ray_tpu\n"
+        "from ray_tpu.util.client import start_client_server\n"
+        "ray_tpu.init(num_cpus=4)\n"
+        "start_client_server(port=0, host='127.0.0.1')\n")
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + ":" + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.monotonic() + 120
+    addr = None
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline().decode()
+        if "listening on" in line:
+            addr = line.strip().rsplit(" ", 1)[1]
+            break
+        if proc.poll() is not None:
+            raise RuntimeError("client server died: "
+                               + proc.stdout.read().decode()[-2000:])
+    assert addr, "client server never came up"
+    yield f"ray-tpu://{addr}"
+    proc.kill()
+
+
+def test_client_tasks_objects_actors(client_cluster):
+    ray_tpu.shutdown()
+    ray_tpu.init(address=client_cluster)
+    try:
+        # objects
+        ref = ray_tpu.put({"hello": 42})
+        assert ray_tpu.get(ref, timeout=60)["hello"] == 42
+
+        # tasks (including ref args crossing the proxy)
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        r1 = add.remote(1, 2)
+        r2 = add.remote(r1, ray_tpu.put(10))
+        assert ray_tpu.get(r2, timeout=120) == 13
+
+        # wait
+        ready, pending = ray_tpu.wait([r1, r2], num_returns=2, timeout=60)
+        assert len(ready) == 2 and not pending
+
+        # actors
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def incr(self, by=1):
+                self.n += by
+                return self.n
+
+        c = Counter.options(num_cpus=0.1).remote(100)
+        assert ray_tpu.get(c.incr.remote(), timeout=120) == 101
+        assert ray_tpu.get(c.incr.remote(5), timeout=60) == 106
+
+        # errors propagate
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("kaboom")
+
+        with pytest.raises(Exception, match="kaboom"):
+            ray_tpu.get(boom.remote(), timeout=120)
+
+        # cluster info
+        assert ray_tpu.cluster_resources().get("CPU") == 4.0
+    finally:
+        ray_tpu.shutdown()
