@@ -71,13 +71,21 @@ class ClientWorker:
 
     # -- plumbing ------------------------------------------------------
 
+    # ops with side effects must not be blindly re-sent after a
+    # post-delivery connection drop (double execution); pre-send failures
+    # still retry safely inside RetryingRpcClient
+    _MUTATING = ("Put", "SubmitTask", "CreateActor", "SubmitActorTask",
+                 "KillActor")
+
     def _call(self, method: str, req: dict, timeout: Optional[float] = None):
         import pickle
 
         req = dict(req, session=self.session_id)
+        retries = 0 if method in self._MUTATING else None
         fut = asyncio.run_coroutine_threadsafe(
             self.client.call(method, pickle.dumps(req),
-                             timeout=timeout or 300.0), self.loop)
+                             timeout=timeout or 300.0, retries=retries),
+            self.loop)
         return pickle.loads(fut.result(timeout=(timeout or 300.0) + 30))
 
     @staticmethod
@@ -118,13 +126,18 @@ class ClientWorker:
         reply = self._call("Wait", {
             "refs": [r.binary() for r in refs],
             "num_returns": num_returns, "timeout": timeout,
-        }, timeout=(timeout or 300.0) + 10)
+        }, timeout=(timeout or 86400.0) + 10)
         by_id = {r.binary(): r for r in refs}
         return ([by_id[b] for b in reply["ready"]],
                 [by_id[b] for b in reply["pending"]])
 
     def free_objects(self, refs):
-        pass  # proxy reaps on disconnect
+        """Explicit release on the proxy (automatic finalizer-driven GC is
+        deferred; the session grace-reaper is the backstop)."""
+        try:
+            self._call("ReleaseRefs", {"refs": [r.binary() for r in refs]})
+        except Exception:
+            pass
 
     # -- tasks ---------------------------------------------------------
 
@@ -182,7 +195,11 @@ class ClientWorker:
                                  "no_restart": no_restart})
 
     def cancel(self, ref, force=False, recursive=True):
-        pass
+        import logging
+
+        logging.getLogger("ray_tpu").warning(
+            "ray_tpu.cancel() is not supported in client mode yet; the "
+            "task keeps running")
 
     # -- cluster info --------------------------------------------------
 
@@ -199,16 +216,23 @@ class ClientWorker:
 
     def as_future(self, ref):
         import concurrent.futures
+        import threading as _th
 
         fut: "concurrent.futures.Future" = concurrent.futures.Future()
-        try:
-            fut.set_result(self.get(ref))
-        except Exception as e:
-            fut.set_exception(e)
+
+        def _resolve():
+            try:
+                fut.set_result(self.get(ref))
+            except Exception as e:
+                fut.set_exception(e)
+
+        _th.Thread(target=_resolve, daemon=True).start()
         return fut
 
     async def await_ref(self, ref):
-        return self.get(ref)
+        # never block the caller's event loop on the round-trip
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self.get, ref)
 
     def shutdown(self):
         try:

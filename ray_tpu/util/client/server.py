@@ -44,10 +44,16 @@ _REAP_GRACE_S = 60.0
 
 class ClientProxyServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 10001):
+        from concurrent.futures import ThreadPoolExecutor
+
         self.server = RpcServer(self._handle, host, port)
         self.server.on_disconnect = self._on_disconnect
         self.sessions: Dict[str, _ClientSession] = {}
         self._conn_session: Dict[int, str] = {}
+        # long-blocking gets/waits each park a thread: give them their own
+        # wide pool so they can't starve other clients' traffic
+        self._pool = ThreadPoolExecutor(max_workers=256,
+                                        thread_name_prefix="client-proxy")
 
     async def start(self) -> str:
         return await self.server.start()
@@ -103,7 +109,7 @@ class ClientProxyServer:
         def blocking(fn, *args, **kw):
             # every cluster op blocks on CoreWorker round-trips: keep them
             # off this event loop so one slow client can't stall the rest
-            return loop.run_in_executor(None, lambda: fn(*args, **kw))
+            return loop.run_in_executor(self._pool, lambda: fn(*args, **kw))
 
         if method == "Put":
             ref = await blocking(ray_tpu.put, cloudpickle.loads(req["blob"]))
@@ -197,6 +203,18 @@ class ClientProxyServer:
                 "available_resources": await blocking(ray_tpu.available_resources),
                 "nodes": await blocking(ray_tpu.nodes),
             })
+
+        if method == "ReleaseRefs":
+            refs = [sess.refs.pop(r, None) for r in req["refs"]]
+            refs = [r for r in refs if r is not None]
+            if refs:
+                try:
+                    from ray_tpu._private.worker import global_worker
+
+                    await blocking(global_worker().free_objects, refs)
+                except Exception:
+                    pass
+            return pickle.dumps({"released": len(refs)})
 
         if method == "Ping":
             return pickle.dumps({"ok": True})
