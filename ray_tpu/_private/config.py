@@ -71,6 +71,7 @@ _d("autoscaler_demand_ttl_s", 15.0)
 #   "Method=N" -> fail the first N calls of Method;
 #   "Method=N:p" -> after the first N, fail with probability p.
 _d("testing_rpc_failure", "")
+_d("testing_rpc_reply_failure", "")  # handler runs, reply dropped (zombies)
 _d("testing_rpc_delay_ms", 0)
 
 # --- GCS / control plane ---

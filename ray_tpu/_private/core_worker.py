@@ -456,11 +456,14 @@ class CoreWorker:
         await self._store_blob(oid, inband, buffers)
         self._in_store[oid] = True
 
-    async def _store_blob(self, oid: ObjectID, inband: bytes, buffers):
+    async def _store_blob(self, oid: ObjectID, inband: bytes, buffers,
+                          attempt: int = 0):
         total, offsets = plan_layout(inband, buffers)
         reply = pickle.loads(await self.raylet.call("StoreCreate", pickle.dumps(
-            {"oid": oid.binary(), "size": total})))
-        if reply["status"] == "exists":
+            {"oid": oid.binary(), "size": total, "attempt": attempt})))
+        if reply["status"] in ("exists", "stale_attempt"):
+            # seal-once: the id is already (or about to be) bound to a value
+            # for this or a newer execution epoch; this writer stands down
             return
         if reply["status"] != "ok":
             raise ObjectLostError(f"object store rejected {oid.hex()}: {reply}")
@@ -476,7 +479,8 @@ class CoreWorker:
                 write_blob(seg.buf, inband, buffers, offsets)
             finally:
                 seg.close()
-        await self.raylet.call("StoreSeal", pickle.dumps({"oid": oid.binary()}))
+        await self.raylet.call("StoreSeal", pickle.dumps(
+            {"oid": oid.binary(), "attempt": attempt}))
 
     async def _read_local_store(self, oid: ObjectID, timeout: float, pull=True):
         reply = pickle.loads(await self.raylet.call("StoreGet", pickle.dumps(
@@ -1006,6 +1010,7 @@ class CoreWorker:
                 # (a restarted actor's queue starts over at 1)
                 view.seqno += 1
                 spec.seqno = view.seqno
+                spec.attempt = record["attempts"]
                 # short connect timeout + one blind reconnect: the address came
                 # from an ALIVE view, so an unreachable peer means the view is
                 # stale — fail fast into the GCS recheck below (the real retry
@@ -1235,7 +1240,7 @@ class CoreWorker:
             if total < RAY_CONFIG.object_inline_max_bytes:
                 results.append(("inline", pack_blob(inband, buffers)))
             else:
-                await self._store_blob(oid, inband, buffers)
+                await self._store_blob(oid, inband, buffers, spec.attempt)
                 results.append(("store", None))
         return pickle.dumps({"status": "ok", "results": results})
 

@@ -142,7 +142,11 @@ class GcsServer:
         self.pgs: Dict[PlacementGroupID, PGRecord] = {}
         self.jobs: Dict[JobID, dict] = {}
         self.job_counter = 0
-        self.object_dir: Dict[bytes, Set[NodeID]] = {}
+        # oid -> {"attempt": committed execution epoch, "nodes": holders};
+        # seal-once at cluster scope: only the newest attempt's copies are
+        # visible, displaced copies are deleted at their nodes (reference:
+        # plasma's seal-once, obj_lifecycle_mgr.cc)
+        self.object_dir: Dict[bytes, dict] = {}
         self.subs: Dict[int, Tuple[ServerConnection, Set[str]]] = {}
         self.conn_jobs: Dict[int, JobID] = {}
         self._worker_clients: Dict[str, RetryingRpcClient] = {}
@@ -345,11 +349,11 @@ class GcsServer:
         self._persist_node(info)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish("nodes", {"event": "removed", "node_id": node_id.hex(), "reason": reason})
-        # drop object locations on that node
-        for oid, nodes in list(self.object_dir.items()):
-            nodes.discard(node_id)
-            if not nodes:
-                del self.object_dir[oid]
+        # drop object locations on that node; keep the committed-attempt
+        # tombstone so a partitioned zombie's stale announce can't
+        # re-register an older epoch as current
+        for oid, entry in list(self.object_dir.items()):
+            entry["nodes"].discard(node_id)
         # fail over actors that lived there
         for record in list(self.actors.values()):
             if record.node_id == node_id and record.state in ("ALIVE", "PENDING_CREATION"):
@@ -441,6 +445,14 @@ class GcsServer:
         for pg in list(self.pgs.values()):
             if pg.spec.creator_job == job_id and pg.spec.lifetime != "detached":
                 await self._remove_pg(pg)
+        # purge the job's object-directory entries (incl. empty tombstones
+        # kept for epoch fencing); ids embed the job id at the task-id tail
+        from ray_tpu._private.ids import TaskID
+
+        jid = job_id.binary()
+        for oid in [o for o in self.object_dir
+                    if o[TaskID.SIZE - len(jid) : TaskID.SIZE] == jid]:
+            del self.object_dir[oid]
 
     # ------------------------------------------------------------------
     # pubsub
@@ -464,26 +476,55 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     async def _rpc_ObjectLocAdd(self, req, conn):
+        node_id = req["node_id"]
+        attempt = req.get("attempt", 0)
         for oid in req["oids"]:
-            self.object_dir.setdefault(oid, set()).add(req["node_id"])
+            entry = self.object_dir.get(oid)
+            if entry is None:
+                self.object_dir[oid] = {"attempt": attempt, "nodes": {node_id}}
+            elif attempt > entry["attempt"]:
+                displaced = entry["nodes"] - {node_id}
+                self.object_dir[oid] = {"attempt": attempt, "nodes": {node_id}}
+                if displaced:
+                    asyncio.ensure_future(
+                        self._delete_stale_copies(oid, attempt, displaced))
+            elif attempt == entry["attempt"]:
+                entry["nodes"].add(node_id)
+            else:
+                # stale-epoch announce: reject, and tell that node to drop it
+                asyncio.ensure_future(self._delete_stale_copies(
+                    oid, entry["attempt"], {node_id}))
         return {"status": "ok"}
+
+    async def _delete_stale_copies(self, oid: bytes, attempt: int, nodes):
+        for node_id in nodes:
+            client = self.node_clients.get(node_id)
+            info = self.nodes.get(node_id)
+            if client is None or info is None or not info.alive:
+                continue
+            try:
+                await client.call("StoreDeleteStale", pickle.dumps(
+                    {"oid": oid, "attempt": attempt}), timeout=10.0, retries=1)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
 
     async def _rpc_ObjectLocRemove(self, req, conn):
         for oid in req["oids"]:
-            nodes = self.object_dir.get(oid)
-            if nodes:
-                nodes.discard(req["node_id"])
-                if not nodes:
-                    del self.object_dir[oid]
+            entry = self.object_dir.get(oid)
+            if entry:
+                # keep the committed-attempt tombstone (empty node set) so a
+                # stale-epoch announce can't re-register; purged at job end
+                entry["nodes"].discard(req["node_id"])
         return {"status": "ok"}
 
     async def _rpc_ObjectLocGet(self, req, conn):
         out = []
-        for node_id in self.object_dir.get(req["oid"], ()):  # alive nodes only
+        entry = self.object_dir.get(req["oid"])
+        for node_id in (entry["nodes"] if entry else ()):  # alive nodes only
             info = self.nodes.get(node_id)
             if info is not None and info.alive:
                 out.append({"node_id": node_id.hex(), "address": info.address})
-        return {"locations": out}
+        return {"locations": out, "attempt": entry["attempt"] if entry else 0}
 
     # ------------------------------------------------------------------
     # scheduling helpers

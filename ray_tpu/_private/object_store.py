@@ -122,7 +122,7 @@ def pack_blob(inband: bytes, buffers: List[memoryview]) -> bytes:
 class _Entry:
     __slots__ = (
         "state", "shm", "shm_name", "size", "last_access", "spill_path", "inline",
-        "arena_offset",
+        "arena_offset", "attempt", "arena_key",
     )
 
     def __init__(self):
@@ -134,6 +134,11 @@ class _Entry:
         self.spill_path = ""
         self.inline: Optional[bytes] = None
         self.arena_offset: Optional[int] = None  # set when backed by the arena
+        # execution-epoch fence (reference: plasma's seal-once semantics,
+        # obj_lifecycle_mgr.cc — here generalized so a retried task's newer
+        # attempt replaces a zombie attempt's copy and stale writers abort)
+        self.attempt = 0
+        self.arena_key: Optional[bytes] = None
 
 
 class ObjectStoreServer:
@@ -169,8 +174,22 @@ class ObjectStoreServer:
                     raise
                 self.arena = None
 
-    def _shm_name(self, oid: bytes) -> str:
-        return f"rtpu_{self.node_hex[:8]}_{oid.hex()}"
+    def _shm_name(self, oid: bytes, attempt: int = 0) -> str:
+        # attempt-qualified so a retry's copy never aliases a zombie writer's
+        # still-mapped file
+        suffix = f"_a{attempt}" if attempt else ""
+        return f"rtpu_{self.node_hex[:8]}_{oid.hex()}{suffix}"
+
+    def _arena_key(self, oid: bytes, attempt: int) -> bytes:
+        # native arena keys are fixed 16 bytes; attempt-salt the key so a
+        # replaced entry's region can sit quarantined under its own key
+        # while the newer attempt allocates the same object id
+        if attempt == 0:
+            return oid
+        import hashlib
+
+        return hashlib.blake2b(oid + attempt.to_bytes(4, "big"),
+                               digest_size=16).digest()
 
     def _region(self, e: _Entry):
         """Server-side view of an entry's bytes (arena slice or shm file)."""
@@ -178,6 +197,19 @@ class ObjectStoreServer:
             view = memoryview(self._arena_view.buf)
             return view[e.arena_offset : e.arena_offset + e.size]
         return memoryview(e.shm.buf)[: e.size]
+
+    def _quarantine_arena(self, key: bytes, size: int):
+        """Defer freeing a displaced arena region: its (stale) writer may
+        still be streaming bytes into a client-side mapping; immediate reuse
+        would corrupt the replacement. Freed after a grace period."""
+        def _free():
+            if self.arena is not None:
+                self.arena.free(key)
+                self.used -= size
+        try:
+            asyncio.get_running_loop().call_later(30.0, _free)
+        except RuntimeError:
+            _free()
 
     def _evict_for(self, need: int) -> bool:
         """Spill least-recently-used sealed objects until `need` bytes fit."""
@@ -210,7 +242,7 @@ class ObjectStoreServer:
         e.spill_path = path
         e.state = "SPILLED"
         if e.arena_offset is not None:
-            self.arena.free(oid)
+            self.arena.free(e.arena_key)
             e.arena_offset = None
         elif e.shm is not None:
             e.shm.close()
@@ -226,14 +258,15 @@ class ObjectStoreServer:
         with open(e.spill_path, "rb") as f:
             data = f.read()
         if self.arena is not None:
-            off = self.arena.alloc(oid, e.size)
+            e.arena_key = e.arena_key or self._arena_key(oid, e.attempt)
+            off = self.arena.alloc(e.arena_key, e.size)
             if off is None or off == -2:
                 return False
             memoryview(self._arena_view.buf)[off : off + e.size] = data
-            self.arena.seal(oid)
+            self.arena.seal(e.arena_key)
             e.arena_offset = off
         else:
-            shm = ShmSegment(self._shm_name(oid), e.size, create=True)
+            shm = ShmSegment(self._shm_name(oid, e.attempt), e.size, create=True)
             shm.buf[:] = data
             e.shm, e.shm_name = shm, shm.name
         os.unlink(e.spill_path)
@@ -245,16 +278,29 @@ class ObjectStoreServer:
 
     # -- operations (all called on the raylet event loop) --
 
-    def create(self, oid: bytes, size: int) -> dict:
-        if oid in self.objects:
-            e = self.objects[oid]
-            return {"status": "exists", "state": e.state}
+    def create(self, oid: bytes, size: int, attempt: int = 0) -> dict:
+        existing = self.objects.get(oid)
+        if existing is not None:
+            if attempt < existing.attempt:
+                # a newer execution epoch already owns this id: the (zombie)
+                # writer must abort without writing or sealing
+                return {"status": "stale_attempt", "attempt": existing.attempt}
+            if attempt == existing.attempt:
+                return {"status": "exists", "state": existing.state}
+            # newer attempt replaces the stale copy (seal-once per epoch)
+            self._displace(oid, existing)
         if not self._evict_for(size):
             return {"status": "oom", "capacity": self.capacity}
         e = _Entry()
         e.size = size
+        e.attempt = attempt
         if self.arena is not None:
-            off = self.arena.alloc(oid, size)
+            e.arena_key = self._arena_key(oid, attempt)
+            off = self.arena.alloc(e.arena_key, size)
+            if off == -2:
+                # key still quarantined from a displaced copy of this very
+                # attempt: the only writer of that epoch is stale — stand down
+                return {"status": "stale_attempt", "attempt": attempt}
             if off is None:
                 return {"status": "oom", "capacity": self.capacity}
             e.arena_offset = off
@@ -262,29 +308,56 @@ class ObjectStoreServer:
             self.used += size
             return {"status": "ok", "arena_name": self.arena_name,
                     "offset": off, "size": size}
-        e.shm = ShmSegment(self._shm_name(oid), size, create=True)
+        e.shm = ShmSegment(self._shm_name(oid, attempt), size, create=True)
         e.shm_name = e.shm.name
         self.objects[oid] = e
         self.used += size
         return {"status": "ok", "shm_name": e.shm_name}
 
-    def put_inline(self, oid: bytes, blob: bytes):
-        if oid in self.objects:
-            return
+    def _displace(self, oid: bytes, e: _Entry):
+        """Drop a stale-attempt entry so a newer attempt can take the id."""
+        del self.objects[oid]
+        if e.arena_offset is not None:
+            # the stale writer may still hold a client-side mapping into the
+            # arena region: quarantine rather than free-and-reuse
+            self._quarantine_arena(e.arena_key, e.size)
+        elif e.shm is not None:
+            self.used -= e.size
+            e.shm.close()
+            e.shm.unlink()
+        if e.spill_path:
+            try:
+                os.unlink(e.spill_path)
+            except FileNotFoundError:
+                pass
+
+    def put_inline(self, oid: bytes, blob: bytes, attempt: int = 0) -> bool:
+        existing = self.objects.get(oid)
+        if existing is not None:
+            if attempt < existing.attempt:
+                return False  # stale epoch: rejected
+            if attempt == existing.attempt:
+                return True  # idempotent
+            self._displace(oid, existing)
         e = _Entry()
         e.inline = blob
         e.size = len(blob)
         e.state = "SEALED"
+        e.attempt = attempt
         self.objects[oid] = e
         self._wake(oid)
+        return True
 
-    def seal(self, oid: bytes):
+    def seal(self, oid: bytes, attempt: int = 0) -> bool:
         e = self.objects.get(oid)
         if e is None:
             raise KeyError(f"seal of unknown object {oid.hex()}")
+        if e.attempt != attempt:
+            return False  # stale writer's seal: fenced off
         e.state = "SEALED"
         e.last_access = time.monotonic()
         self._wake(oid)
+        return True
 
     def _wake(self, oid: bytes):
         for fut in self.waiters.pop(oid, []):
@@ -321,10 +394,15 @@ class ObjectStoreServer:
                     "offset": e.arena_offset, "size": e.size}
         return {"status": "shm", "shm_name": e.shm_name, "size": e.size}
 
-    def read_chunk(self, oid: bytes, offset: int, length: int) -> Optional[bytes]:
-        """Remote transfer read path (works for sealed or spilled objects)."""
+    def read_chunk(self, oid: bytes, offset: int, length: int,
+                   attempt: Optional[int] = None) -> Optional[bytes]:
+        """Remote transfer read path (works for sealed or spilled objects).
+        ``attempt`` fences the source: a mid-pull displacement by a newer
+        epoch must abort the transfer, not mix epochs in one blob."""
         e = self.objects.get(oid)
         if e is None or e.state == "CREATED":
+            return None
+        if attempt is not None and e.attempt != attempt:
             return None
         e.last_access = time.monotonic()
         if e.inline is not None:
@@ -339,11 +417,19 @@ class ObjectStoreServer:
         e = self.objects.get(oid)
         return None if e is None else e.size
 
-    def write_chunk(self, oid: bytes, offset: int, data: bytes):
+    def object_attempt(self, oid: bytes) -> int:
+        e = self.objects.get(oid)
+        return 0 if e is None else e.attempt
+
+    def write_chunk(self, oid: bytes, offset: int, data: bytes,
+                    attempt: int = 0):
         """Pull-side write (store-mediated; remote data lands directly in shm)."""
         e = self.objects.get(oid)
         if e is None or (e.shm is None and e.arena_offset is None):
             raise KeyError(f"write_chunk on missing object {oid.hex()}")
+        if e.attempt != attempt:
+            raise KeyError(f"write_chunk fenced: {oid.hex()} now at "
+                           f"attempt {e.attempt}")
         self._region(e)[offset : offset + len(data)] = data
 
     def delete(self, oids: List[bytes]):
@@ -356,7 +442,7 @@ class ObjectStoreServer:
                     fut.cancel()
             if e.arena_offset is not None:
                 self.used -= e.size
-                self.arena.free(oid)
+                self.arena.free(e.arena_key)
             elif e.shm is not None:
                 self.used -= e.size
                 e.shm.close()

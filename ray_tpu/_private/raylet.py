@@ -426,22 +426,35 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def _rpc_StoreCreate(self, req, conn):
-        return self.store.create(req["oid"], req["size"])
+        return self.store.create(req["oid"], req["size"], req.get("attempt", 0))
 
     async def _rpc_StoreSeal(self, req, conn):
-        self.store.seal(req["oid"])
-        asyncio.ensure_future(self._announce([req["oid"]]))
+        attempt = req.get("attempt", 0)
+        if not self.store.seal(req["oid"], attempt):
+            return {"status": "stale_attempt"}
+        asyncio.ensure_future(self._announce([req["oid"]], attempt))
         return {"status": "ok"}
 
     async def _rpc_StorePutInline(self, req, conn):
-        self.store.put_inline(req["oid"], req["blob"])
-        asyncio.ensure_future(self._announce([req["oid"]]))
+        attempt = req.get("attempt", 0)
+        if not self.store.put_inline(req["oid"], req["blob"], attempt):
+            return {"status": "stale_attempt"}
+        asyncio.ensure_future(self._announce([req["oid"]], attempt))
         return {"status": "ok"}
 
-    async def _announce(self, oids: List[bytes]):
+    async def _rpc_StoreDeleteStale(self, req, conn):
+        """Directory-driven cleanup: drop our copy if it is from an older
+        execution epoch than the committed one (seal-once self-healing)."""
+        if self.store.object_attempt(req["oid"]) < req["attempt"]:
+            self.store.delete([req["oid"]])
+            return {"deleted": True}
+        return {"deleted": False}
+
+    async def _announce(self, oids: List[bytes], attempt: int = 0):
         try:
             await self.gcs.call("ObjectLocAdd", pickle.dumps(
-                {"oids": oids, "node_id": self.node_id}), retries=2)
+                {"oids": oids, "node_id": self.node_id,
+                 "attempt": attempt}), retries=2)
         except (RpcError, asyncio.TimeoutError, OSError):
             logger.warning("failed to announce %d object locations", len(oids))
 
@@ -460,10 +473,11 @@ class Raylet:
 
     async def _rpc_StoreMeta(self, req, conn):
         size = self.store.object_size(req["oid"])
-        return {"size": size}
+        return {"size": size, "attempt": self.store.object_attempt(req["oid"])}
 
     async def _rpc_StoreFetchChunk(self, req, conn):
-        data = self.store.read_chunk(req["oid"], req["offset"], req["length"])
+        data = self.store.read_chunk(req["oid"], req["offset"], req["length"],
+                                     req.get("attempt"))
         return {"data": data}
 
     async def _rpc_StoreDelete(self, req, conn):
@@ -508,8 +522,9 @@ class Raylet:
                 if size is None:
                     await asyncio.sleep(0.1)
                     continue
-                created = self.store.create(oid, size)
-                if created["status"] == "exists":
+                attempt = meta.get("attempt", 0)
+                created = self.store.create(oid, size, attempt)
+                if created["status"] in ("exists", "stale_attempt"):
                     return
                 if created["status"] != "ok":
                     logger.warning("pull %s: local store oom", oid.hex()[:12])
@@ -518,19 +533,29 @@ class Raylet:
                 while offset < size:
                     n = min(chunk, size - offset)
                     r = pickle.loads(await src.call("StoreFetchChunk", pickle.dumps(
-                        {"oid": oid, "offset": offset, "length": n})))
+                        {"oid": oid, "offset": offset, "length": n,
+                         "attempt": attempt})))
                     data = r.get("data")
                     if data is None:
-                        raise RpcError("source evicted object mid-pull")
-                    self.store.write_chunk(oid, offset, data)
+                        raise RpcError("source evicted or displaced object mid-pull")
+                    try:
+                        self.store.write_chunk(oid, offset, data, attempt)
+                    except KeyError:
+                        # displaced locally by a newer attempt: clean abort —
+                        # the newer copy is (or will be) the committed one
+                        return
                     offset += n
-                self.store.seal(oid)
-                await self._announce([oid])
+                if self.store.seal(oid, attempt):
+                    await self._announce([oid], attempt)
                 return
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 logger.warning("pull %s from %s failed: %s", oid.hex()[:12],
                                locations[0]["address"], e)
-                self.store.delete([oid])
+                # only clean up OUR partial copy — a newer attempt may have
+                # displaced the entry mid-transfer and must not be deleted
+                if self.store.object_attempt(oid) == attempt \
+                        and not self.store.contains(oid):
+                    self.store.delete([oid])
                 await asyncio.sleep(0.2)
             finally:
                 await src.close()

@@ -53,10 +53,11 @@ class RpcApplicationError(RpcError):
 
 
 class _ChaosState:
-    def __init__(self):
+    def __init__(self, spec: Optional[str] = None):
         self._counts: Dict[str, int] = {}
         self._spec: Dict[str, Tuple[int, float]] = {}
-        spec = RAY_CONFIG.testing_rpc_failure
+        if spec is None:
+            spec = RAY_CONFIG.testing_rpc_failure
         if spec:
             for entry in spec.split(","):
                 method, _, rest = entry.partition("=")
@@ -148,6 +149,10 @@ class RpcServer:
         self._port = port
         self._server: Optional[asyncio.AbstractServer] = None
         self._chaos = _ChaosState()
+        # reply-side chaos (reference rpc_chaos.h's reply-failure flavor):
+        # the handler RUNS, then the connection drops before the reply —
+        # produces zombie executions whose side effects raced a retry
+        self._reply_chaos = _ChaosState(RAY_CONFIG.testing_rpc_reply_failure)
         self.connections: Dict[int, ServerConnection] = {}
         self.on_disconnect: Optional[Callable[[ServerConnection], Awaitable[None]]] = None
 
@@ -202,6 +207,10 @@ class RpcServer:
         try:
             await _maybe_chaos(self._chaos, method)
             result = await self._handler(method, payload, conn)
+            if self._reply_chaos.should_fail(method):
+                conn.writer.close()
+                conn.closed.set()
+                return
             if msg_id is not None:
                 await conn.reply(msg_id, _REPLY_OK, result if result is not None else b"")
         except Exception as e:
