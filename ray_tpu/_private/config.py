@@ -96,16 +96,29 @@ _d("object_chunk_bytes", 8 * 1024**2)
 _d("object_spill_dir", "")  # default: <session>/spill
 _d("object_pull_timeout_s", 120.0)
 _d("object_store_backend", "auto")  # "auto" | "cpp" | "shm"
+# pre-touch this much of the arena at start: first-touch page faults on
+# /dev/shm cost ~65ms per 10MB on some hosts vs ~1ms warm
+_d("object_store_prewarm_bytes", 256 * 1024**2)
 
 # --- tasks / actors ---
 _d("task_max_retries", 3)
 _d("actor_max_restarts", 0)
 _d("max_pending_lease_requests", 16)
+_d("worker_startup_concurrency", 2)  # concurrent cold worker spawns per node
+_d("prestart_workers", 2)  # idle workers spawned at raylet start
 _d("max_lineage_bytes", 64 * 1024**2)
+# ownership-based distributed refcounting (reference: reference_counter.h:44)
+_d("distributed_refcounting", 1)
+_d("free_grace_s", 1.0)  # settle delay before a zero-ref free (in-flight borrows)
+_d("borrow_debounce_s", 0.25)  # skip borrow RPCs for transient handles
+_d("max_object_reconstructions", 5)
 
 # --- train / libs ---
 _d("train_health_check_period_s", 1.0)
 _d("serve_proxy_port", 8000)
+# consecutive failed health checks before a slow-but-alive replica is
+# replaced (first-request XLA compiles can starve health replies)
+_d("serve_health_strikes", 30)
 
 # --- logging / session ---
 _d("session_root", "/tmp/ray_tpu_sessions")

@@ -34,6 +34,7 @@ from ray_tpu._private.common import ActorOptions, TaskOptions, TaskSpec
 from ray_tpu._private.config import RAY_CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu._private.object_store import SegmentCache, pack_blob, plan_layout, read_blob, write_blob, ShmSegment
+from ray_tpu._private.reference_counter import ReferenceCounter
 from ray_tpu._private.rpc import (
     RpcApplicationError,
     RpcError,
@@ -74,84 +75,129 @@ class _ActorView:
 
 
 class _LeasePool:
-    """Per-scheduling-key worker lease pool (reference: the SchedulingKey
-    queues in normal_task_submitter.cc — pipelined lease requests capped at
-    max_pending_lease_requests, granted workers reused for queued tasks of
-    the same shape, returned to the raylet after an idle timeout)."""
+    """Per-scheduling-key task queue + worker lease pool (reference: the
+    SchedulingKey queues in normal_task_submitter.cc — pipelined lease
+    requests capped at max_pending_lease_requests, granted workers reused
+    for queued tasks of the same shape, returned after an idle timeout).
+
+    Throughput design for the asyncio tier: one *pusher* coroutine per
+    granted lease pops queued task records and ships them in BATCHES over a
+    single ``PushTaskBatch`` RPC, amortizing the per-call framing/event-loop
+    overhead that otherwise dominates small-task throughput."""
+
+    BATCH = 16
 
     def __init__(self, core: "CoreWorker", key, opts, resources):
+        from collections import deque
+
         self.core = core
         self.key = key
         self.opts = opts
         self.resources = resources
-        self.idle: List[dict] = []
-        self.waiters: "asyncio.Queue[asyncio.Future]" = None  # lazily via deque
-        from collections import deque
+        self.pending = deque()  # task records awaiting a pusher
+        self.pushers = 0
+        self._work = asyncio.Event()  # set while pending is non-empty
 
-        self._waiters = deque()
-        self.in_flight = 0
-        self._reaper: Optional[asyncio.Task] = None
+    def submit(self, record: dict):
+        record.setdefault("_done", asyncio.Event())
+        self.pending.append(record)
+        self._work.set()
+        self._ensure_pushers()
 
-    async def acquire(self) -> dict:
-        if self.idle:
-            return self.idle.pop()
-        fut = self.core.loop.create_future()
-        self._waiters.append(fut)
-        self._maybe_request()
-        result = await fut
-        if isinstance(result, Exception):
-            raise result
-        return result
-
-    def _maybe_request(self):
+    def _ensure_pushers(self):
         cap = RAY_CONFIG.max_pending_lease_requests
-        while self.in_flight < min(len(self._waiters), cap):
-            self.in_flight += 1
-            asyncio.ensure_future(self._request_one())
+        # one pusher per BATCH of queued work: a 100-task burst wants ~7
+        # leases, not 16 cold worker spawns that steal the CPU the tasks need
+        want = min(max(1, (len(self.pending) + self.BATCH - 1) // self.BATCH),
+                   cap)
+        while self.pushers < want:
+            self.pushers += 1
+            asyncio.ensure_future(self._pusher())
 
-    async def _request_one(self):
+    async def _pusher(self):
+        """Acquire one lease, then drain the queue in batches until idle."""
         try:
-            lease = await self._do_request()
-        except Exception as e:
-            self.in_flight -= 1
-            while self._waiters:
-                fut = self._waiters.popleft()
-                if not fut.done():
-                    fut.set_result(e)
-                    break
-            return
-        self.in_flight -= 1
-        self._hand_out(lease)
-
-    def _hand_out(self, lease: dict):
-        while self._waiters:
-            fut = self._waiters.popleft()
-            if not fut.done():
-                fut.set_result(lease)
+            try:
+                lease = await self._do_request()
+            except Exception as e:
+                # a lease is unobtainable (infeasible / timeout): fail the
+                # queued tasks rather than wedging them
+                if self.pushers == 1:
+                    while self.pending:
+                        record = self.pending.popleft()
+                        self.core._complete_error(record, TaskError(
+                            f"scheduling failed for {record['name']}: {e}",
+                            traceback.format_exc()))
                 return
-        lease["last_used"] = time.monotonic()
-        self.idle.append(lease)
-        if self._reaper is None or self._reaper.done():
-            self._reaper = asyncio.ensure_future(self._reap_idle())
-
-    def release(self, lease: dict):
-        self._hand_out(lease)
-
-    async def discard(self, lease: dict):
-        await self.core._drop_lease(lease)
-        self._maybe_request()
-
-    async def _reap_idle(self):
-        while self.idle or self._waiters or self.in_flight:
-            await asyncio.sleep(0.5)
-            now = time.monotonic()
-            keep = []
-            for lease in self.idle:
-                if now - lease["last_used"] > _LEASE_IDLE_S:
+            idle_deadline = None
+            while True:
+                batch = []
+                while self.pending and len(batch) < self.BATCH:
+                    batch.append(self.pending.popleft())
+                if not batch:
+                    self._work.clear()
+                    if self.pending:  # a submit raced the clear
+                        continue
+                    if idle_deadline is None:
+                        idle_deadline = time.monotonic() + _LEASE_IDLE_S
+                    remaining = idle_deadline - time.monotonic()
+                    if remaining <= 0:
+                        await self.core._drop_lease(lease)
+                        return
+                    try:
+                        await asyncio.wait_for(self._work.wait(), remaining)
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+                idle_deadline = None
+                ok = await self._push_batch(lease, batch)
+                if not ok:
                     await self.core._drop_lease(lease)
+                    return
+        finally:
+            self.pushers -= 1
+            if self.pending:
+                self._work.set()
+                self._ensure_pushers()
+
+    async def _push_batch(self, lease: dict, batch: List[dict]) -> bool:
+        """Ship a batch to the leased worker. Returns False if the lease
+        died (records are retried/failed individually)."""
+        core = self.core
+        for record in batch:
+            record["epoch"] = record.get("epoch", -1) + 1
+            record["spec"].attempt = record["epoch"]
+        payload = pickle.dumps({"specs": [r["spec"] for r in batch]})
+        try:
+            reply = pickle.loads(await core._worker_client(
+                lease["worker_address"]).call(
+                    "PushTaskBatch", payload, timeout=86400.0, retries=0))
+        except (RpcError, asyncio.TimeoutError, OSError) as e:
+            for record in batch:
+                record["attempts"] += 1
+                if record["attempts"] > record["max_retries"]:
+                    core._complete_error(record, TaskError(
+                        f"worker died running {record['name']} "
+                        f"(after {record['attempts']} attempts): {e}", ""))
                 else:
-                    keep.append(lease)
-            self.idle = keep
+                    logger.warning("retrying task %s (attempt %d): %s",
+                                   record["name"], record["attempts"], e)
+                    self.pending.append(record)
+            return False
+        for record, res in zip(batch, reply["results"]):
+            if res["status"] == "ok":
+                core._process_reply_refs(res, lease["worker_address"])
+                core._complete_ok(record, res["results"])
+            else:
+                err: TaskError = pickle.loads(res["error"])
+                opts = record["spec"].options
+                if opts.retry_exceptions \
+                        and record["attempts"] < record["max_retries"]:
+                    record["attempts"] += 1
+                    self.pending.append(record)
+                else:
+                    core._complete_error(record, err)
+        return True
 
     async def _do_request(self) -> dict:
         opts, resources = self.opts, self.resources
@@ -170,9 +216,22 @@ class _LeasePool:
         }
         deadline = time.monotonic() + RAY_CONFIG.worker_start_timeout_s * 4
         while True:
-            reply = pickle.loads(await raylet.call(
-                "RequestWorkerLease", pickle.dumps(req),
-                timeout=RAY_CONFIG.worker_start_timeout_s + 30))
+            try:
+                reply = pickle.loads(await raylet.call(
+                    "RequestWorkerLease", pickle.dumps(req),
+                    timeout=RAY_CONFIG.worker_start_timeout_s + 30,
+                    connect_timeout=5.0, retries=1))
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                # raylet unreachable (node died between pick and lease):
+                # re-pick a node until the GCS view catches up
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"lease request kept failing: {e}")
+                await asyncio.sleep(0.5)
+                node2 = await self.core._pick_node(opts, resources)
+                if node2 is not None:
+                    node = node2
+                    raylet = self.core._raylet_client(node["address"])
+                continue
             if reply["status"] == "granted":
                 return {"key": self.key, "lease_id": reply["lease_id"],
                         "worker_address": reply["worker_address"],
@@ -225,12 +284,18 @@ class CoreWorker:
         self._result_futures: Dict[ObjectID, asyncio.Future] = {}
         self._in_store: Dict[ObjectID, bool] = {}
         self._tasks: Dict[TaskID, dict] = {}  # lineage / retry records
+        self._lineage_bytes = 0
+        # ownership refcounting (reference: reference_counter.h:44)
+        self.ref_counter = ReferenceCounter(lambda: self.address)
+        self._free_pending: set = set()
+        self._registered_borrows: set = set()
         self._lease_cache: Dict[tuple, List[dict]] = {}
         self._renv_prepared: Dict[str, dict] = {}
         self.job_runtime_env: Optional[dict] = None
         self._actors: Dict[ActorID, _ActorView] = {}
         self._actor_name_cache: Dict[ActorID, tuple] = {}
         self._pushed_functions: set = set()
+        self._fn_key_cache: Dict[int, tuple] = {}
         self._put_index = 0
         self._spread_hint = 0
         self.segments = SegmentCache()
@@ -276,7 +341,25 @@ class CoreWorker:
     def connect(self):
         self._start_loop()
         self._run(self._connect())
+        if RAY_CONFIG.distributed_refcounting:
+            from ray_tpu import object_ref as object_ref_mod
+
+            self.ref_counter.on_owned_zero = self._on_owned_zero
+            self.ref_counter.on_borrow_zero = self._on_borrow_zero
+            self.ref_counter.on_borrow_first = self._on_borrow_first
+            object_ref_mod.set_ref_counter(self.ref_counter)
+            # periodic drain of the __del__-safe deletion queue (refs dropped
+            # while the process is otherwise idle must still free)
+            asyncio.run_coroutine_threadsafe(self._refcount_sweep(), self.loop)
         return self
+
+    async def _refcount_sweep(self):
+        while not self._shutdown:
+            try:
+                self.ref_counter.flush_deletes()
+            except Exception:
+                logger.exception("refcount sweep failed")
+            await asyncio.sleep(0.2)
 
     async def _connect(self):
         self.server = RpcServer(self._handle_rpc)
@@ -415,12 +498,17 @@ class CoreWorker:
         return out
 
     async def _push_function(self, obj) -> str:
+        cached = self._fn_key_cache.get(id(obj))
+        if cached is not None and cached[0] is obj:
+            return cached[1]
         blob = cloudpickle.dumps(obj)
         key = hashlib.sha1(blob).hexdigest()
         if key not in self._pushed_functions:
             await self._gcs_call("KVPut", {"ns": "fn", "key": key, "value": blob,
                                            "overwrite": False})
             self._pushed_functions.add(key)
+        # keyed by identity WITH a strong ref so a recycled id can't alias
+        self._fn_key_cache[id(obj)] = (obj, key)
         return key
 
     async def _fetch_function(self, key: str):
@@ -448,13 +536,18 @@ class CoreWorker:
         return ObjectRef(oid, self.address)
 
     async def _put_value(self, oid: ObjectID, value: Any):
-        inband, buffers = serialize(value)
+        from ray_tpu.object_ref import collect_serialized_refs
+
+        with collect_serialized_refs() as inner:
+            inband, buffers = serialize(value)
         total = len(inband) + sum(b.nbytes for b in buffers)
         if total < RAY_CONFIG.object_inline_max_bytes:
             self.memory_store[oid] = value
             return
         await self._store_blob(oid, inband, buffers)
         self._in_store[oid] = True
+        # a stored blob holds refs only as bytes: pin them for its lifetime
+        self.ref_counter.pin_nested(oid.binary(), inner)
 
     async def _store_blob(self, oid: ObjectID, inband: bytes, buffers,
                           attempt: int = 0):
@@ -504,6 +597,7 @@ class CoreWorker:
 
     async def _get_one(self, ref: ObjectRef, deadline: float) -> Any:
         oid = ref.id
+        lost_hint = False
         while True:
             # 1. local memory store (own small results)
             if oid in self.memory_store:
@@ -525,17 +619,28 @@ class CoreWorker:
                     oid, max(0.1, deadline - time.monotonic()))
                 if ok:
                     return value
-                raise ObjectLostError(f"object {oid.hex()} lost from store")
+                # lost from the store (e.g. the holding node died):
+                # reconstruct from lineage by re-executing the producer
+                self._in_store.pop(oid, None)
+                if await self._recover_object(oid):
+                    continue
+                raise ObjectLostError(f"object {oid.hex()} lost from store "
+                                      f"and not reconstructable")
             # 4. remote owner fetch (small objects / long-poll for pending)
             owner = ref.owner_address()
             if owner and owner != self.address:
-                value, in_store = await self._fetch_from_owner(ref, deadline)
+                value, in_store = await self._fetch_from_owner(
+                    ref, deadline, lost=lost_hint)
+                lost_hint = False
                 if in_store:
                     ok, value = await self._read_local_store(
                         oid, max(0.1, deadline - time.monotonic()))
                     if ok:
                         return value
-                    raise ObjectLostError(f"object {oid.hex()} lost from store")
+                    # tell the owner on the next round so it can verify and
+                    # trigger lineage reconstruction
+                    lost_hint = True
+                    continue
                 return value
             # 5. last resort: the store via directory pull
             ok, value = await self._read_local_store(
@@ -545,7 +650,8 @@ class CoreWorker:
             if time.monotonic() > deadline:
                 raise GetTimeoutError(f"timed out resolving {oid.hex()}")
 
-    async def _fetch_from_owner(self, ref: ObjectRef, deadline: float):
+    async def _fetch_from_owner(self, ref: ObjectRef, deadline: float,
+                                lost: bool = False):
         client = self._worker_client(ref.owner_address())
         while True:
             timeout = deadline - time.monotonic()
@@ -553,8 +659,10 @@ class CoreWorker:
                 raise GetTimeoutError(f"timed out fetching {ref.hex()} from owner")
             try:
                 reply = pickle.loads(await client.call("GetOwnedObject", pickle.dumps(
-                    {"oid": ref.binary(), "timeout": min(timeout, 10.0)}),
+                    {"oid": ref.binary(), "timeout": min(timeout, 10.0),
+                     "lost": lost}),
                     timeout=min(timeout, 10.0) + 5.0, retries=1))
+                lost = False
             except (RpcError, asyncio.TimeoutError) as e:
                 raise ObjectLostError(
                     f"owner {ref.owner_address()} of {ref.hex()} unreachable: {e}")
@@ -681,52 +789,282 @@ class CoreWorker:
         return await self._maybe_pull_device(value, deadline)
 
     def free_objects(self, refs: List[ObjectRef]):
-        from ray_tpu.experimental.device_objects import DeviceObjectMarker
-
         async def _free():
             oids = []
+            freed_in_store = []
             for r in refs:
                 # a marker in the memory store points at a device-held value:
                 # release that too, or it would be orphaned forever
-                value = self.memory_store.get(r.id)
-                if isinstance(value, DeviceObjectMarker):
-                    self._device_fetch_cache.pop(value.oid, None)
-                    if value.address == self.address:
-                        self.device_store.pop(value.oid, None)
-                    else:
-                        try:
-                            await self._worker_client(value.address).call(
-                                "FreeDeviceObject",
-                                pickle.dumps({"oid": value.oid}),
-                                timeout=10.0, retries=1)
-                        except (RpcError, asyncio.TimeoutError, OSError):
-                            pass
+                await self._maybe_free_device_marker(self.memory_store.get(r.id))
                 self.memory_store.pop(r.id, None)
-                self._in_store.pop(r.id, None)
+                if self._in_store.pop(r.id, None):
+                    freed_in_store.append(r.binary())
+                self.ref_counter.release_nested(r.binary())
                 oids.append(r.binary())
+            if freed_in_store:
+                try:
+                    await self._gcs_call("ObjectFree", {"oids": freed_in_store})
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass
             await self.raylet.call("StoreDelete", pickle.dumps({"oids": oids}))
 
         self._run(_free())
+
+    # ------------------------------------------------------------------
+    # ownership refcounting + lineage (reference: reference_counter.cc,
+    # task_manager.cc, object_recovery_manager.cc)
+    # ------------------------------------------------------------------
+
+    def _on_owned_zero(self, oid: bytes):
+        """All local refs/pins/borrowers of an owned object released."""
+        if self._shutdown:
+            return
+        try:
+            self.loop.call_soon_threadsafe(self._schedule_free, oid)
+        except RuntimeError:
+            pass
+
+    def _schedule_free(self, oid: bytes):
+        if not RAY_CONFIG.distributed_refcounting or oid in self._free_pending:
+            return
+        self._free_pending.add(oid)
+
+        def _fire():
+            if not self._shutdown:
+                asyncio.ensure_future(self._free_owned(oid))
+
+        # grace delay absorbs in-flight AddBorrower registrations
+        self.loop.call_later(RAY_CONFIG.free_grace_s, _fire)
+
+    async def _free_owned(self, oid_bytes: bytes):
+        self._free_pending.discard(oid_bytes)
+        rc = self.ref_counter
+        if not rc.freeable(oid_bytes):
+            return
+        oid = ObjectID(oid_bytes)
+        fut = self._result_futures.get(oid)
+        if fut is not None and not fut.done():
+            return  # production in flight; completion re-checks
+        is_put = bool(oid.return_index() & 0x8000)
+        if rc.lineage_count(oid_bytes) > 0 and is_put:
+            # a retained downstream task's args need this value and a put
+            # cannot be reconstructed: keep it until the lineage releases
+            return
+        value = self.memory_store.pop(oid, None)
+        await self._maybe_free_device_marker(value)
+        self._result_futures.pop(oid, None)
+        in_store = self._in_store.pop(oid, None)
+        rc.release_nested(oid_bytes)
+        if in_store:
+            try:
+                await self._gcs_call("ObjectFree", {"oids": [oid_bytes]})
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
+        if rc.lineage_count(oid_bytes) == 0:
+            rc.drop(oid_bytes)
+        self._maybe_drop_record(oid.task_id())
+
+    async def _maybe_free_device_marker(self, value):
+        from ray_tpu.experimental.device_objects import DeviceObjectMarker
+
+        if not isinstance(value, DeviceObjectMarker):
+            return
+        self._device_fetch_cache.pop(value.oid, None)
+        if value.address == self.address:
+            self.device_store.pop(value.oid, None)
+        else:
+            try:
+                await self._worker_client(value.address).call(
+                    "FreeDeviceObject", pickle.dumps({"oid": value.oid}),
+                    timeout=10.0, retries=1)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
+
+    def _on_borrow_first(self, oid: bytes, owner: str):
+        """First local handle to a foreign-owned object: register as a
+        borrower with the owner (debounced to skip transient handles)."""
+        if self._shutdown or not owner:
+            return
+
+        def _later():
+            self.loop.call_later(
+                RAY_CONFIG.borrow_debounce_s,
+                lambda: asyncio.ensure_future(self._register_borrow(oid, owner)))
+
+        try:
+            self.loop.call_soon_threadsafe(_later)
+        except RuntimeError:
+            pass
+
+    async def _register_borrow(self, oid: bytes, owner: str):
+        rc = self.ref_counter
+        if rc.local_count(oid) <= 0 or oid in self._registered_borrows:
+            return
+        self._registered_borrows.add(oid)
+        try:
+            await self._worker_client(owner).call("AddBorrower", pickle.dumps(
+                {"oid": oid, "address": self.address}), timeout=10.0, retries=1)
+        except (RpcError, asyncio.TimeoutError, OSError):
+            pass
+
+    def _on_borrow_zero(self, oid: bytes, owner: str):
+        if self._shutdown:
+            return
+
+        def _later():
+            self.loop.call_later(
+                RAY_CONFIG.borrow_debounce_s,
+                lambda: asyncio.ensure_future(self._unregister_borrow(oid, owner)))
+
+        try:
+            self.loop.call_soon_threadsafe(_later)
+        except RuntimeError:
+            pass
+
+    async def _unregister_borrow(self, oid: bytes, owner: str):
+        rc = self.ref_counter
+        if rc.local_count(oid) > 0 or oid not in self._registered_borrows:
+            return
+        self._registered_borrows.discard(oid)
+        try:
+            await self._worker_client(owner).call("RemoveBorrower", pickle.dumps(
+                {"oid": oid, "address": self.address}), timeout=10.0, retries=1)
+        except (RpcError, asyncio.TimeoutError, OSError):
+            pass
+
+    def _register_lineage(self, task_id: TaskID, record: dict):
+        """Retain the task record for reconstruction while its outputs are
+        referenced; cap total retained bytes (reference: task_manager.h:183
+        max_lineage_bytes)."""
+        self._tasks[task_id] = record
+        for oid, _owner in record.get("arg_refs", ()):
+            self.ref_counter.lineage_add(oid)
+        self._lineage_bytes += record.get("bytes", 0)
+        cap = RAY_CONFIG.max_lineage_bytes
+        if self._lineage_bytes <= cap:
+            return
+        for tid, rec in list(self._tasks.items()):
+            if self._lineage_bytes <= cap:
+                break
+            if rec is record or rec.get("_recover_event") is not None:
+                continue
+            fut_pending = any(
+                (f := self._result_futures.get(rid)) is not None and not f.done()
+                for rid in rec.get("return_ids", ()))
+            if fut_pending:
+                continue
+            self._drop_record(tid, rec)  # outputs become non-reconstructable
+
+    def _maybe_drop_record(self, task_id: TaskID):
+        rec = self._tasks.get(task_id)
+        if rec is None or rec.get("_recover_event") is not None:
+            return
+        rc = self.ref_counter
+        for rid in rec.get("return_ids", ()):
+            b = rid.binary()
+            if not rc.freeable(b) or rc.lineage_count(b) > 0:
+                return
+            fut = self._result_futures.get(rid)
+            if fut is not None and not fut.done():
+                return
+        self._drop_record(task_id, rec)
+
+    def _drop_record(self, task_id: TaskID, rec: dict):
+        self._tasks.pop(task_id, None)
+        self._lineage_bytes -= rec.get("bytes", 0)
+        rc = self.ref_counter
+        for rid in rec.get("return_ids", ()):
+            if rc.lineage_count(rid.binary()) == 0 and rc.freeable(rid.binary()):
+                rc.drop(rid.binary())
+        for oid, owner in rec.get("arg_refs", ()):
+            rc.lineage_remove(oid)
+            if not owner or owner == self.address:
+                # the arg may now be fully releasable (cascades up the DAG)
+                if rc.freeable(oid) and rc.lineage_count(oid) == 0:
+                    self._schedule_free(oid)
+                self._maybe_drop_record(ObjectID(oid).task_id())
+
+    def _release_task_pins(self, record: dict):
+        if record.pop("_pinned", None):
+            for oid, _owner in record.get("arg_refs", ()):
+                self.ref_counter.unpin(oid)
+
+    def _process_reply_refs(self, reply: dict, executor_addr: str):
+        """Handle borrow/nested-ref reports carried on a task reply (the
+        protocol replacing the reference's borrower-chain handshake)."""
+        for oid, owner in reply.get("borrows", ()):
+            if not owner or owner == self.address:
+                self.ref_counter.add_borrower(oid, executor_addr)
+            else:
+                asyncio.ensure_future(self._forward_borrow(owner, oid, executor_addr))
+        nested = reply.get("nested") or {}
+        for ret_oid, inner in nested.items():
+            self.ref_counter.pin_nested(ret_oid, list(inner))
+            for oid, owner in inner:
+                if owner and owner != self.address:
+                    asyncio.ensure_future(
+                        self._forward_borrow(owner, oid, self.address))
+
+    async def _forward_borrow(self, owner: str, oid: bytes, borrower: str):
+        try:
+            await self._worker_client(owner).call("AddBorrower", pickle.dumps(
+                {"oid": oid, "address": borrower}), timeout=10.0, retries=1)
+        except (RpcError, asyncio.TimeoutError, OSError):
+            pass
+
+    async def _recover_object(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction: re-execute the producing task (reference:
+        object_recovery_manager.h:41). Returns True if a re-execution was
+        run (caller re-checks the object)."""
+        rec = self._tasks.get(oid.task_id())
+        if rec is None:
+            return False
+        ev = rec.get("_recover_event")
+        if ev is not None:
+            await ev.wait()
+            return True
+        if rec.get("_recoveries", 0) >= RAY_CONFIG.max_object_reconstructions:
+            return False
+        rec["_recoveries"] = rec.get("_recoveries", 0) + 1
+        rec["_recover_event"] = ev = asyncio.Event()
+        logger.warning("object %s lost; reconstructing via lineage re-execution "
+                       "of %s (recovery %d)", oid.hex()[:12], rec["name"],
+                       rec["_recoveries"])
+        try:
+            for rid in rec["return_ids"]:
+                self._in_store.pop(rid, None)
+                self.memory_store.pop(rid, None)
+                old = self._result_futures.get(rid)
+                if old is None or old.done():
+                    self._result_futures[rid] = self.loop.create_future()
+            rec["attempts"] = 0  # fresh retry budget for the recovery run
+            for ob, ow in rec.get("arg_refs", ()):
+                self.ref_counter.pin(ob, ow)
+            rec["_pinned"] = True
+            await self._drive_task(rec)
+        finally:
+            rec.pop("_recover_event", None)
+            ev.set()
+        return True
 
     # ------------------------------------------------------------------
     # task submission (owner side)
     # ------------------------------------------------------------------
 
     def submit_task(self, remote_fn, args, kwargs, opts: TaskOptions):
+        """Non-blocking submission: everything cheap happens on the caller
+        thread; the drive coroutine is kicked off fire-and-forget so batched
+        ``.remote()`` loops pipeline instead of paying a cross-thread round
+        trip per call (reference: the owner-side submit path is the tasks/s
+        hot loop, normal_task_submitter.cc)."""
         task_id = TaskID.of(self.job_id)
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
                 for i in range(opts.num_returns)]
-        self._run(self._submit_task_async(remote_fn, args, kwargs, opts, task_id, refs))
-        return refs[0] if opts.num_returns == 1 else refs
-
-    async def _submit_task_async(self, remote_fn, args, kwargs, opts, task_id, refs):
-        opts.runtime_env = await self._prepare_runtime_env(opts.runtime_env)
-        function_key = await self._push_function(remote_fn.function)
-        args_blob = self._pack_args(args, kwargs)
+        args_blob, arg_refs = self._pack_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
-            function_key=function_key,
+            function_key="",  # filled by _drive_task_prepared
             args_blob=args_blob,
             num_returns=opts.num_returns,
             options=opts,
@@ -734,13 +1072,38 @@ class CoreWorker:
         )
         max_retries = opts.max_retries if opts.max_retries >= 0 else RAY_CONFIG.task_max_retries
         record = {"spec": spec, "attempts": 0, "max_retries": max_retries,
-                  "refs": refs, "name": remote_fn.function_name}
-        self._tasks[task_id] = record
+                  "return_ids": [ref.id for ref in refs],
+                  "arg_refs": arg_refs, "bytes": len(args_blob) + 512,
+                  "name": remote_fn.function_name}
+        for oid, owner in arg_refs:
+            self.ref_counter.pin(oid, owner)
+        record["_pinned"] = True
         for ref in refs:
-            self._result_futures[ref.id] = self.loop.create_future()
-        asyncio.ensure_future(self._drive_task(record))
+            # created off-loop so a get() racing the kickoff finds them
+            self._result_futures[ref.id] = asyncio.Future(loop=self.loop)
 
-    def _pack_args(self, args, kwargs) -> bytes:
+        def _kickoff():
+            self._register_lineage(task_id, record)
+            asyncio.ensure_future(self._drive_task_prepared(remote_fn, record))
+
+        self.loop.call_soon_threadsafe(_kickoff)
+        return refs[0] if opts.num_returns == 1 else refs
+
+    async def _drive_task_prepared(self, remote_fn, record: dict):
+        """Resolve the (cached) function key + runtime env, then drive."""
+        spec: TaskSpec = record["spec"]
+        try:
+            spec.options.runtime_env = await self._prepare_runtime_env(
+                spec.options.runtime_env)
+            spec.function_key = await self._push_function(remote_fn.function)
+        except Exception as e:
+            self._complete_error(record, TaskError(
+                f"submission failed for {record['name']}: {e}",
+                traceback.format_exc()))
+            return
+        await self._drive_task(record)
+
+    def _pack_args(self, args, kwargs):
         # inline small owned values so the executor need not call back
         def _inline(v):
             if isinstance(v, ObjectRef) and v.id in self.memory_store:
@@ -749,70 +1112,57 @@ class CoreWorker:
                     return value
             return v
 
+        from ray_tpu.object_ref import collect_serialized_refs
+
         args = tuple(_inline(a) for a in args)
         kwargs = {k: _inline(v) for k, v in kwargs.items()}
-        return pack_blob(*serialize((args, kwargs)))
+        with collect_serialized_refs() as arg_refs:
+            blob = pack_blob(*serialize((args, kwargs)))
+        return blob, arg_refs
 
     async def _drive_task(self, record: dict):
-        """Submit with lease reuse; retry on worker failure (reference:
-        normal_task_submitter.cc + task_manager.cc)."""
+        """Queue onto the scheduling-key pool (lease reuse + batched pushes;
+        reference: normal_task_submitter.cc + task_manager.cc) and wait for
+        completion. Retries on worker failure happen inside the pool."""
         spec: TaskSpec = record["spec"]
         opts: TaskOptions = spec.options
-        resources = opts.required_resources()
-        while True:
-            try:
-                pool, lease = await self._acquire_lease(opts, resources)
-            except Exception as e:
-                self._complete_error(record, TaskError(
-                    f"scheduling failed for {record['name']}: {e}", traceback.format_exc()))
-                return
-            spec.attempt = record["attempts"]
-            try:
-                reply = pickle.loads(await self._worker_client(lease["worker_address"]).call(
-                    "PushTask", pickle.dumps({"spec": spec}), timeout=86400.0, retries=0))
-            except (RpcError, asyncio.TimeoutError, OSError) as e:
-                await pool.discard(lease)
-                record["attempts"] += 1
-                if record["attempts"] > record["max_retries"]:
-                    self._complete_error(record, TaskError(
-                        f"worker died running {record['name']} "
-                        f"(after {record['attempts']} attempts): {e}", ""))
-                    return
-                logger.warning("retrying task %s (attempt %d): %s",
-                               record["name"], record["attempts"], e)
-                continue
-            pool.release(lease)
-            if reply["status"] == "ok":
-                self._complete_ok(record, reply["results"])
-                return
-            err: TaskError = pickle.loads(reply["error"])
-            if opts.retry_exceptions and record["attempts"] < record["max_retries"]:
-                record["attempts"] += 1
-                continue
-            self._complete_error(record, err)
-            return
+        pool = self._lease_pool_for(opts, opts.required_resources())
+        record["_done"] = asyncio.Event()
+        pool.submit(record)
+        await record["_done"].wait()
 
     def _complete_ok(self, record, results):
-        for ref, (kind, payload) in zip(record["refs"], results):
+        for oid, (kind, payload) in zip(record["return_ids"], results):
             if kind == "inline":
                 inband, buffers = read_blob(payload)
-                self.memory_store[ref.id] = deserialize(inband, buffers)
+                self.memory_store[oid] = deserialize(inband, buffers)
             else:  # stored in the distributed object store
-                self._in_store[ref.id] = True
-            fut = self._result_futures.get(ref.id)
+                self._in_store[oid] = True
+            fut = self._result_futures.get(oid)
             if fut is not None and not fut.done():
                 fut.set_result(True)
+        self._release_task_pins(record)
+        done = record.get("_done")
+        if done is not None:
+            done.set()
+        for oid in record["return_ids"]:
+            if self.ref_counter.freeable(oid.binary()):
+                self._schedule_free(oid.binary())
 
     def _complete_error(self, record, err: TaskError):
-        for ref in record["refs"]:
-            self.memory_store[ref.id] = err
-            fut = self._result_futures.get(ref.id)
+        for oid in record["return_ids"]:
+            self.memory_store[oid] = err
+            fut = self._result_futures.get(oid)
             if fut is not None and not fut.done():
                 fut.set_result(True)
+        self._release_task_pins(record)
+        done = record.get("_done")
+        if done is not None:
+            done.set()
 
     # -- leases --
 
-    async def _acquire_lease(self, opts: TaskOptions, resources):
+    def _lease_pool_for(self, opts: TaskOptions, resources) -> _LeasePool:
         from ray_tpu._private.runtime_env import env_hash
 
         key = (_freeze(resources), _freeze(opts.label_selector),
@@ -823,8 +1173,7 @@ class CoreWorker:
         if pool is None:
             pool = _LeasePool(self, key, opts, resources)
             self._lease_cache[key] = pool
-        lease = await pool.acquire()
-        return pool, lease
+        return pool
 
     async def _pick_node(self, opts: TaskOptions, resources) -> Optional[dict]:
         strat = opts.scheduling_strategy
@@ -911,11 +1260,16 @@ class CoreWorker:
         opts.runtime_env = await self._prepare_runtime_env(opts.runtime_env)
         function_key = await self._push_function(actor_cls.cls)
         task_id = TaskID.of(self.job_id)
+        args_blob, arg_refs = self._pack_args(args, kwargs)
+        # creation args may carry refs; pin them for the actor's lifetime
+        # (restarts re-resolve them from this owner)
+        for oid, owner in arg_refs:
+            self.ref_counter.pin(oid, owner)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
             function_key=function_key,
-            args_blob=self._pack_args(args, kwargs),
+            args_blob=args_blob,
             num_returns=0,
             options=opts,
             owner_address=self.address,
@@ -946,23 +1300,17 @@ class CoreWorker:
 
     def submit_actor_task(self, handle, method_name, args, kwargs, num_returns=1,
                           tensor_transport=""):
+        """Non-blocking (see submit_task): actor calls pipeline without a
+        per-call cross-thread round trip."""
         task_id = TaskID.of(self.job_id)
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
                 for i in range(num_returns)]
-        self._run(self._submit_actor_task_async(
-            handle, method_name, args, kwargs, num_returns, task_id, refs,
-            tensor_transport))
-        return refs[0] if num_returns == 1 else refs
-
-    async def _submit_actor_task_async(self, handle, method_name, args, kwargs,
-                                       num_returns, task_id, refs,
-                                       tensor_transport=""):
-        view = self._actor_view(handle.actor_id)
+        args_blob, arg_refs = self._pack_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
             function_key="",
-            args_blob=self._pack_args(args, kwargs),
+            args_blob=args_blob,
             num_returns=num_returns,
             options=TaskOptions(num_returns=num_returns),
             owner_address=self.address,
@@ -972,10 +1320,21 @@ class CoreWorker:
         )
         record = {"spec": spec, "attempts": 0,
                   "max_retries": handle._max_task_retries,
-                  "refs": refs, "name": f"{handle._class_name}.{method_name}"}
+                  "return_ids": [ref.id for ref in refs],
+                  "arg_refs": arg_refs,
+                  "name": f"{handle._class_name}.{method_name}"}
+        for oid, owner in arg_refs:
+            self.ref_counter.pin(oid, owner)
+        record["_pinned"] = True
         for ref in refs:
-            self._result_futures[ref.id] = self.loop.create_future()
-        asyncio.ensure_future(self._drive_actor_task(view, record))
+            self._result_futures[ref.id] = asyncio.Future(loop=self.loop)
+
+        def _kickoff():
+            view = self._actor_view(handle.actor_id)
+            asyncio.ensure_future(self._drive_actor_task(view, record))
+
+        self.loop.call_soon_threadsafe(_kickoff)
+        return refs[0] if num_returns == 1 else refs
 
     async def _drive_actor_task(self, view: _ActorView, record: dict):
         spec: TaskSpec = record["spec"]
@@ -1010,7 +1369,8 @@ class CoreWorker:
                 # (a restarted actor's queue starts over at 1)
                 view.seqno += 1
                 spec.seqno = view.seqno
-                spec.attempt = record["attempts"]
+                record["epoch"] = record.get("epoch", -1) + 1
+                spec.attempt = record["epoch"]
                 # short connect timeout + one blind reconnect: the address came
                 # from an ALIVE view, so an unreachable peer means the view is
                 # stale — fail fast into the GCS recheck below (the real retry
@@ -1030,6 +1390,7 @@ class CoreWorker:
                     return
                 continue
             if reply["status"] == "ok":
+                self._process_reply_refs(reply, view.address)
                 self._complete_ok(record, reply["results"])
             else:
                 self._complete_error(record, pickle.loads(reply["error"]))
@@ -1084,8 +1445,22 @@ class CoreWorker:
         if method == "PushTask":
             req = pickle.loads(payload)
             return await self._handle_push_task(req["spec"])
+        if method == "PushTaskBatch":
+            req = pickle.loads(payload)
+            results = []
+            for spec in req["specs"]:
+                results.append(pickle.loads(await self._handle_push_task(spec)))
+            return pickle.dumps({"results": results})
         if method == "GetOwnedObject":
             return await self._handle_get_owned(pickle.loads(payload))
+        if method == "AddBorrower":
+            req = pickle.loads(payload)
+            self.ref_counter.add_borrower(req["oid"], req["address"])
+            return pickle.dumps({"status": "ok"})
+        if method == "RemoveBorrower":
+            req = pickle.loads(payload)
+            self.ref_counter.remove_borrower(req["oid"], req["address"])
+            return pickle.dumps({"status": "ok"})
         if method == "Ping":
             return pickle.dumps({"status": "ok", "pid": os.getpid()})
         if method == "GetDeviceObject":
@@ -1119,6 +1494,20 @@ class CoreWorker:
     async def _handle_get_owned(self, req) -> bytes:
         oid = ObjectID(req["oid"])
         deadline = time.monotonic() + req.get("timeout", 10.0)
+        if req.get("lost") and self._in_store.get(oid):
+            # a borrower failed to pull a copy: verify against the directory
+            # and reconstruct from lineage if it is really gone
+            try:
+                locs = await self._gcs_call("ObjectLocGet", {"oid": oid.binary()})
+            except (RpcError, asyncio.TimeoutError, OSError):
+                locs = {"locations": [None]}  # can't verify: assume alive
+            if not locs["locations"]:
+                self._in_store.pop(oid, None)
+                if not await self._recover_object(oid):
+                    err = ObjectLostError(
+                        f"object {oid.hex()} lost and not reconstructable")
+                    return pickle.dumps({"status": "error",
+                                         "error": pickle.dumps(err)})
         while True:
             if oid in self.memory_store:
                 value = self.memory_store[oid]
@@ -1159,13 +1548,15 @@ class CoreWorker:
         if self.job_id.is_nil():
             self.job_id = spec.job_id
         fn = await self._fetch_function(spec.function_key)
-        args, kwargs = await self._resolve_args(spec.args_blob)
+        args, kwargs, seen_refs = await self._resolve_args(spec.args_blob)
         self._ensure_pool(1)
         t0 = time.time()
         result, err = await self.loop.run_in_executor(
             self._exec_pool, self._call_user_fn, fn, args, kwargs, spec)
         self._trace_task(spec, getattr(fn, "__name__", "task"), t0, err)
-        return await self._pack_results(spec, result, err)
+        del args, kwargs  # drop our handles before computing borrows
+        return await self._pack_results(
+            spec, result, err, borrows=self._surviving_borrows(seen_refs))
 
     def _trace_task(self, spec: TaskSpec, name: str, t0: float, err):
         """Span per executed task (reference: profile_event.cc into the
@@ -1194,8 +1585,11 @@ class CoreWorker:
             self._tls.task_id = None
 
     async def _resolve_args(self, args_blob: bytes):
+        from ray_tpu.object_ref import collect_deserialized_refs
+
         inband, buffers = read_blob(args_blob)
-        args, kwargs = deserialize(inband, buffers)
+        with collect_deserialized_refs() as seen_refs:
+            args, kwargs = deserialize(inband, buffers)
 
         async def _resolve(v):
             if isinstance(v, ObjectRef):
@@ -1208,10 +1602,21 @@ class CoreWorker:
 
         args = [await _resolve(a) for a in args]
         kwargs = {k: await _resolve(v) for k, v in kwargs.items()}
-        return args, kwargs
+        return args, kwargs, seen_refs
+
+    def _surviving_borrows(self, seen_refs):
+        """Foreign refs from the args that are still held in this process
+        after execution — reported on the reply so the owner registers this
+        worker as a borrower (reference: GetAndClearBorrowedRefs)."""
+        out = []
+        for oid, owner in {(o, w) for o, w in seen_refs}:
+            if owner and owner != self.address \
+                    and self.ref_counter.local_count(oid) > 0:
+                out.append((oid, owner))
+        return out
 
     async def _pack_results(self, spec: TaskSpec, result, err,
-                            transport: str = "") -> bytes:
+                            transport: str = "", borrows=()) -> bytes:
         if err is not None:
             return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
         values: List[Any]
@@ -1226,7 +1631,10 @@ class CoreWorker:
                     f"task declared num_returns={spec.num_returns} but returned "
                     f"{len(values)} values", "")
                 return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
+        from ray_tpu.object_ref import collect_serialized_refs
+
         results = []
+        nested: Dict[bytes, list] = {}
         for i, value in enumerate(values):
             oid = ObjectID.for_task_return(spec.task_id, i)
             if transport:
@@ -1235,20 +1643,28 @@ class CoreWorker:
 
                 self.device_store[oid.binary()] = value
                 value = DeviceObjectMarker(oid.binary(), self.address, transport)
-            inband, buffers = serialize(value)
+            with collect_serialized_refs() as inner:
+                inband, buffers = serialize(value)
             total = len(inband) + sum(b.nbytes for b in buffers)
             if total < RAY_CONFIG.object_inline_max_bytes:
                 results.append(("inline", pack_blob(inband, buffers)))
+                # inline values are rehydrated in the owner's memory store;
+                # the live inner refs there carry the counts
             else:
                 await self._store_blob(oid, inband, buffers, spec.attempt)
                 results.append(("store", None))
-        return pickle.dumps({"status": "ok", "results": results})
+                if inner:
+                    # stored blobs hold refs only as bytes: the owner must
+                    # pin them for the blob's lifetime
+                    nested[oid.binary()] = inner
+        return pickle.dumps({"status": "ok", "results": results,
+                             "borrows": list(borrows), "nested": nested})
 
     async def _exec_actor_creation(self, spec: TaskSpec) -> bytes:
         if self.job_id.is_nil():
             self.job_id = spec.job_id
         cls = await self._fetch_function(spec.function_key)
-        args, kwargs = await self._resolve_args(spec.args_blob)
+        args, kwargs, _seen = await self._resolve_args(spec.args_blob)
         opts = spec.actor_options
         self._ensure_pool(max(1, opts.max_concurrency), replace=True)
         self.actor_id = spec.actor_id
@@ -1292,6 +1708,23 @@ class CoreWorker:
             return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
         if spec.seqno > 0:
             await self._wait_for_turn(spec)
+        if spec.method_name == "__rtpu_dag_loop__":
+            # compiled-graph data plane: install this actor's static schedule
+            # and run it on a dedicated thread — no further control-plane
+            # traffic per iteration (reference: dag_node_operation.py:704)
+            from ray_tpu.dag.executor import DagLoopRunner
+
+            args, kwargs, _seen = await self._resolve_args(spec.args_blob)
+            try:
+                runner = DagLoopRunner(self.actor_instance, args[0])
+                runner.start()
+                self._dag_runner = runner  # keep alive with the actor
+            except Exception as e:
+                err = TaskError(repr(e), traceback.format_exc())
+                return pickle.dumps({"status": "app_error",
+                                     "error": pickle.dumps(err)})
+            return pickle.dumps({"status": "ok", "results": [
+                ("inline", pack_blob(*serialize("started")))]})
         method = getattr(self.actor_instance, spec.method_name, None)
         if method is None:
             err = TaskError(f"AttributeError: no method {spec.method_name}", "")
@@ -1302,7 +1735,7 @@ class CoreWorker:
                      or getattr(method, "__ray_tpu_tensor_transport__", ""))
         if transport == "object":
             transport = ""
-        args, kwargs = await self._resolve_args(spec.args_blob)
+        args, kwargs, seen_refs = await self._resolve_args(spec.args_blob)
         t0 = time.time()
         if asyncio.iscoroutinefunction(method):
             async with self._actor_sem:
@@ -1314,7 +1747,10 @@ class CoreWorker:
             result, err = await self.loop.run_in_executor(
                 self._exec_pool, self._call_user_fn, method, args, kwargs, spec)
         self._trace_task(spec, spec.method_name, t0, err)
-        return await self._pack_results(spec, result, err, transport=transport)
+        del args, kwargs  # drop our handles before computing borrows
+        return await self._pack_results(
+            spec, result, err, transport=transport,
+            borrows=self._surviving_borrows(seen_refs))
 
     # ------------------------------------------------------------------
     # shutdown
@@ -1324,6 +1760,10 @@ class CoreWorker:
         if self._shutdown:
             return
         self._shutdown = True
+        from ray_tpu import object_ref as object_ref_mod
+
+        if getattr(object_ref_mod, "_ref_counter", None) is self.ref_counter:
+            object_ref_mod.set_ref_counter(None)
         try:
             from ray_tpu.util import tracing
 
@@ -1333,10 +1773,6 @@ class CoreWorker:
             pass
 
         async def _close():
-            for pool in self._lease_cache.values():
-                for lease in pool.idle:
-                    await self._drop_lease(lease)
-                pool.idle.clear()
             if self.server:
                 await self.server.stop()
             if self.gcs:

@@ -517,6 +517,35 @@ class GcsServer:
                 entry["nodes"].discard(req["node_id"])
         return {"status": "ok"}
 
+    _FREED_EPOCH = 1 << 62  # tombstone attempt: beats any real epoch
+
+    async def _rpc_ObjectFree(self, req, conn):
+        """Owner-initiated cluster-wide free: zero references remain, so the
+        copies on every holding node are deleted and the entry becomes a
+        freed tombstone (reference: the owner's delete fan-out on ref-count
+        zero). The tombstone's infinite epoch makes any late announce (e.g.
+        a pull that completed mid-free) route into the stale-copy deletion
+        path instead of resurrecting the object. Purged at job end."""
+        per_node: Dict[NodeID, List[bytes]] = {}
+        for oid in req["oids"]:
+            entry = self.object_dir.get(oid)
+            if entry:
+                for node_id in entry["nodes"]:
+                    per_node.setdefault(node_id, []).append(oid)
+            self.object_dir[oid] = {"attempt": self._FREED_EPOCH,
+                                    "nodes": set()}
+        for node_id, oids in per_node.items():
+            client = self.node_clients.get(node_id)
+            info = self.nodes.get(node_id)
+            if client is None or info is None or not info.alive:
+                continue
+            try:
+                await client.call("StoreDelete", pickle.dumps({"oids": oids}),
+                                  timeout=10.0, retries=1)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
+        return {"status": "ok"}
+
     async def _rpc_ObjectLocGet(self, req, conn):
         out = []
         entry = self.object_dir.get(req["oid"])

@@ -83,7 +83,9 @@ def main(duration: float = 2.0) -> List[Dict]:
     results.append(timeit(
         "put_small_per_s", lambda: (ray_tpu.put(small), 1)[1], duration))
 
-    big = np.random.bytes(10 * 1024 * 1024)
+    # numpy payload rides the out-of-band zero-copy path (shm-mapped on
+    # read), like ray_perf.py's large-object cases
+    big = np.frombuffer(np.random.bytes(10 * 1024 * 1024), dtype=np.uint8)
 
     def put_gig():
         ref = ray_tpu.put(big)

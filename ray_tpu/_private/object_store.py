@@ -453,6 +453,29 @@ class ObjectStoreServer:
                 except FileNotFoundError:
                     pass
 
+    _ZERO_CHUNK = b"\x00" * (8 * 1024 * 1024)
+
+    def prewarm_step(self, offset: int) -> Optional[int]:
+        """Pre-touch one arena chunk at ``offset`` (first-touch /dev/shm
+        page faults are ~60x slower than warm writes on some hosts).
+        Returns the next offset, or None when done. Runs on the store's
+        event loop between awaits, so the live-region check is atomic with
+        respect to allocations; chunks overlapping any live entry are
+        skipped rather than zeroed."""
+        if self._arena_view is None:
+            return None
+        limit = min(self.capacity, RAY_CONFIG.object_store_prewarm_bytes)
+        if offset >= limit:
+            return None
+        n = min(len(self._ZERO_CHUNK), limit - offset)
+        end = offset + n
+        for e in self.objects.values():
+            if e.arena_offset is not None \
+                    and e.arena_offset < end and offset < e.arena_offset + e.size:
+                return end  # live data here: skip this chunk
+        memoryview(self._arena_view.buf)[offset:end] = self._ZERO_CHUNK[:n]
+        return end
+
     def stats(self) -> dict:
         return {
             "capacity": self.capacity,

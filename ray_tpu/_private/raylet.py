@@ -96,6 +96,8 @@ class Raylet:
         self._pulls: Dict[bytes, asyncio.Task] = {}
         self._background: List[asyncio.Task] = []
         self._spawn_env = dict(os.environ)
+        self._spawn_sem = asyncio.Semaphore(
+            max(1, RAY_CONFIG.worker_startup_concurrency))
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -118,6 +120,8 @@ class Raylet:
         await self.gcs.call("RegisterNode", pickle.dumps({"info": info}))
         self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._background.append(asyncio.ensure_future(self._monitor_workers_loop()))
+        self._background.append(asyncio.ensure_future(self._prestart_workers()))
+        self._background.append(asyncio.ensure_future(self._prewarm_store()))
         if self.log_dir:
             self._background.append(
                 asyncio.ensure_future(self._log_monitor_loop()))
@@ -208,16 +212,29 @@ class Raylet:
     async def _pop_worker(self, job_hex: Optional[str],
                           renv: Optional[dict] = None,
                           renv_hash: str = "") -> WorkerProc:
-        for i, w in enumerate(self.idle_workers):
-            if (w.job_hex is None or w.job_hex == job_hex) \
-                    and w.renv_hash == renv_hash:
-                self.idle_workers.pop(i)
-                w.job_hex = w.job_hex or job_hex
+        while True:
+            for i, w in enumerate(self.idle_workers):
+                if (w.job_hex is None or w.job_hex == job_hex) \
+                        and w.renv_hash == renv_hash:
+                    self.idle_workers.pop(i)
+                    w.job_hex = w.job_hex or job_hex
+                    return w
+            # bound concurrent spawns: each new worker pays a full
+            # interpreter+import start-up; a spawn storm starves the very
+            # tasks the leases are for (reference: worker_pool.h's
+            # maximum_startup_concurrency)
+            async with self._spawn_sem:
+                for i, w in enumerate(self.idle_workers):
+                    if (w.job_hex is None or w.job_hex == job_hex) \
+                            and w.renv_hash == renv_hash:
+                        self.idle_workers.pop(i)
+                        w.job_hex = w.job_hex or job_hex
+                        return w
+                w = self._spawn_worker(renv, renv_hash)
+                await asyncio.wait_for(w.registered,
+                                       RAY_CONFIG.worker_start_timeout_s)
+                w.job_hex = job_hex
                 return w
-        w = self._spawn_worker(renv, renv_hash)
-        await asyncio.wait_for(w.registered, RAY_CONFIG.worker_start_timeout_s)
-        w.job_hex = job_hex
-        return w
 
     async def _rpc_RegisterWorker(self, req, conn):
         pid = req["pid"]
@@ -259,6 +276,31 @@ class Raylet:
                 }), timeout=5.0, retries=0)
             except Exception:
                 pass
+
+    async def _prewarm_store(self):
+        """Pre-touch arena pages in the background so early large puts
+        don't pay first-touch fault costs (chunked; yields the loop)."""
+        offset = 0
+        while True:
+            nxt = self.store.prewarm_step(offset)
+            if nxt is None:
+                return
+            offset = nxt
+            await asyncio.sleep(0.02)
+
+    async def _prestart_workers(self):
+        """Warm the pool so first leases don't pay interpreter start-up
+        (reference: worker_pool prestart)."""
+        for _ in range(max(0, RAY_CONFIG.prestart_workers)):
+            try:
+                async with self._spawn_sem:
+                    w = self._spawn_worker()
+                    await asyncio.wait_for(
+                        w.registered, RAY_CONFIG.worker_start_timeout_s)
+                w.job_hex = None
+                self.idle_workers.append(w)
+            except Exception:
+                return
 
     async def _monitor_workers_loop(self):
         while True:

@@ -27,6 +27,11 @@ def main():
     from ray_tpu._private.logs import setup_process_logging
 
     setup_process_logging("worker", args.log_dir)
+    import faulthandler
+
+    # `kill -USR1 <pid>` dumps all thread stacks to the worker log — the
+    # ray-stack equivalent for debugging silent hangs
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
 
     from ray_tpu._private import worker as worker_mod
     from ray_tpu._private.core_worker import CoreWorker

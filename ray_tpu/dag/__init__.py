@@ -14,6 +14,7 @@ next tier of this module (see channels.py for the channel primitives).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
@@ -148,17 +149,61 @@ class MultiOutputNode(DAGNode):
                 for o in self._bound_args]
 
 
+class CompiledDAGRef:
+    """Handle to one in-flight compiled-graph execution (reference:
+    CompiledDAGRef — results must be consumed in submission order)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._consumed = False
+
+    def get(self, timeout: float = 300.0):
+        if self._consumed:
+            raise ValueError("compiled DAG result was already consumed; "
+                             "results can be read once, in submission order")
+        self._consumed = True
+        return self._dag._get_result(self._seq, timeout)
+
+
 class CompiledDAG:
-    """Precompiled schedule: topological order fixed once, actors created
-    eagerly (reference: compiled_dag_node.py:805; execute :2546)."""
+    """A real compiled execution plan (reference: compiled_dag_node.py:805,
+    static per-actor schedules from dag_node_operation.py:704).
+
+    Compilation assigns every ClassMethodNode to its actor, allocates one
+    shared-memory channel per cross-actor edge (acked single-writer slots,
+    channels.py), and ships each actor ONE static schedule which it runs on
+    a dedicated thread. After compile, an execute() is a single channel
+    write and get() a single channel read — the driver and the control
+    plane are out of the per-iteration loop entirely.
+
+    Single-host scope: channels live in /dev/shm (the multi-node test
+    harness shares one host); cross-host edges would ride the object plane.
+    """
 
     def __init__(self, root: DAGNode):
+        import uuid as _uuid
+
         self._root = root
         self._order = self._toposort(root)
-        # instantiate all actors up front
+        self._uuid = _uuid.uuid4().hex[:10]
+        self._seq = 0
+        self._results_read = 0
+        self._buffer: Dict[int, Any] = {}
+        self._torn_down = False
         for node in self._order:
-            if isinstance(node, ClassNode):
-                node._get_actor((), {}, {})
+            if isinstance(node, FunctionNode):
+                raise ValueError(
+                    "compiled graphs support actor methods only (bind "
+                    "functions run eagerly via dag.execute())")
+        # instantiate all actors up front (class nodes hang off the method
+        # nodes' targets, not the arg-dependency edges)
+        for node in self._order:
+            if isinstance(node, ClassMethodNode) \
+                    and isinstance(node._target, ClassNode):
+                node._target._get_actor((), {}, {})
+        self._build_plan()
+        self._launch_loops()
 
     @staticmethod
     def _toposort(root) -> List[DAGNode]:
@@ -179,15 +224,210 @@ class CompiledDAG:
         visit(root)
         return seen
 
-    def execute(self, *args, **kwargs):
-        cache: Dict[int, Any] = {}
-        return self._root._execute_node(args, kwargs, cache)
+    # -- compilation --
 
-    def teardown(self):
-        for node in self._order:
-            if isinstance(node, ClassNode) and node._actor_handle is not None:
+    def _actor_of(self, node: "ClassMethodNode"):
+        if isinstance(node._target, ClassNode):
+            return node._target._actor_handle
+        return node._target  # pre-existing ActorHandle
+
+    def _build_plan(self):
+        """Assign ops to actors, allocate channels, build schedules."""
+        from ray_tpu.dag.channels import Channel
+
+        method_nodes = [n for n in self._order
+                        if isinstance(n, ClassMethodNode)]
+        self._input_chan_name = f"{self._uuid}_in"
+        # node -> producing channel name (cross-actor edges only)
+        chan_of: Dict[int, str] = {}
+        terminals: List[DAGNode] = (
+            list(self._root._bound_args)
+            if isinstance(self._root, MultiOutputNode) else [self._root])
+        self._num_outputs = len(terminals)
+        for i, t in enumerate(terminals):
+            if not isinstance(t, ClassMethodNode):
+                raise ValueError("compiled DAG outputs must be actor methods")
+        # channels: input + one per method node that has any cross-actor or
+        # driver reader
+        readers_of: Dict[str, List[Any]] = {self._input_chan_name: []}
+        for n in method_nodes:
+            chan_of[id(n)] = f"{self._uuid}_{len(chan_of)}"
+            readers_of[chan_of[id(n)]] = []
+
+        def note_reader(chan_name, party):
+            lst = readers_of[chan_name]
+            if all(p is not party for p in lst):
+                lst.append(party)
+
+        # who reads what
+        schedules: Dict[Any, dict] = {}  # actor handle -> schedule
+
+        def sched_for(actor):
+            key = actor.actor_id
+            if key not in schedules:
+                schedules[key] = {"actor": actor, "chan_readers": {},
+                                  "ops": [], "node_idx": {}}
+            return schedules[key]
+
+        for n in method_nodes:
+            actor = self._actor_of(n)
+            sched = sched_for(actor)
+            arg_spec = []
+            for v in list(n._bound_args) + list(n._bound_kwargs.values()):
+                if isinstance(v, InputNode):
+                    note_reader(self._input_chan_name, sched)
+                    arg_spec.append(("chan_idx",
+                                     (self._input_chan_name, v._index)))
+                elif isinstance(v, ClassMethodNode):
+                    if self._actor_of(v) == actor:
+                        arg_spec.append(("local", sched["node_idx"][id(v)]))
+                    else:
+                        cname = chan_of[id(v)]
+                        note_reader(cname, sched)
+                        arg_spec.append(("chan", cname))
+                elif isinstance(v, DAGNode):
+                    raise ValueError(
+                        f"unsupported node type in compiled DAG: {type(v)}")
+                else:
+                    arg_spec.append(("const", v))
+            op_idx = len(sched["ops"])
+            sched["node_idx"][id(n)] = op_idx
+            sched["ops"].append({"method": n._method_name, "args": arg_spec,
+                                 "out": None})
+        # driver reads the terminal channels
+        self._out_chans_names: List[str] = []
+        for t in terminals:
+            cname = chan_of[id(t)]
+            note_reader(cname, "driver")
+            self._out_chans_names.append(cname)
+        # wire out-channels for ops with readers
+        for n in method_nodes:
+            cname = chan_of[id(n)]
+            if readers_of[cname]:
+                actor = self._actor_of(n)
+                sched = sched_for(actor)
+                sched["ops"][sched["node_idx"][id(n)]]["out"] = cname
+        # allocate channels (driver creates; actors attach)
+        self._channels: List[Channel] = []
+        self._driver_slots: Dict[str, int] = {}
+        for cname, readers in readers_of.items():
+            if cname != self._input_chan_name and not readers:
+                continue  # unconsumed intermediate: no channel needed
+            num = max(1, len(readers))
+            ch = Channel(cname, create=True, num_readers=num)
+            self._channels.append(ch)
+            for slot, party in enumerate(readers):
+                if party == "driver":
+                    self._driver_slots[cname] = slot
+                else:
+                    party["chan_readers"][cname] = slot
+        self._in_chan = next(
+            c for c in self._channels if c.name.endswith("_in"))
+        self._out_chans: Dict[str, Channel] = {}
+        for cname in self._out_chans_names:
+            self._out_chans[cname] = Channel(
+                cname, reader_slot=self._driver_slots[cname])
+        self._schedules = list(schedules.values())
+        # the input channel is fed from a dedicated thread so execute() never
+        # blocks the driver when the pipeline is full (the driver must stay
+        # free to drain results — otherwise submit-all-then-get deadlocks)
+        import queue as _queue
+        import threading as _threading
+
+        self._submit_q: "_queue.Queue" = _queue.Queue()
+        self._submit_err: Optional[BaseException] = None
+
+        def _feed():
+            while True:
+                item = self._submit_q.get()
+                if item is None:
+                    return
                 try:
-                    ray_tpu.kill(node._actor_handle)
-                except Exception:
-                    pass
-                node._actor_handle = None
+                    self._in_chan.write(item)
+                except BaseException as e:
+                    self._submit_err = e
+                    return
+
+        self._submit_thread = _threading.Thread(
+            target=_feed, name="rtpu-dag-submit", daemon=True)
+        self._submit_thread.start()
+
+    def _launch_loops(self):
+        from ray_tpu.actor import ActorMethod
+        from ray_tpu.dag.executor import DAG_LOOP_METHOD
+
+        refs = []
+        for sched in self._schedules:
+            actor = sched["actor"]
+            payload = {"chan_readers": sched["chan_readers"],
+                       "ops": sched["ops"]}
+            refs.append(ActorMethod(actor, DAG_LOOP_METHOD).remote(payload))
+        for r in refs:
+            out = ray_tpu.get(r, timeout=120)
+            if out != "started":
+                raise RuntimeError(f"dag loop failed to start: {out}")
+
+    # -- execution --
+
+    def execute(self, *args, **kwargs):
+        if kwargs:
+            raise TypeError("compiled DAG execute() takes positional inputs "
+                            "only (the plan is index-based)")
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        if self._submit_err is not None:
+            raise RuntimeError(f"compiled DAG input feed failed: "
+                               f"{self._submit_err}")
+        self._submit_q.put(tuple(args))
+        ref = CompiledDAGRef(self, self._seq)
+        self._seq += 1
+        return ref
+
+    def _get_result(self, seq: int, timeout: float):
+        from ray_tpu.dag.channels import ChannelError, _Stop
+
+        if seq in self._buffer:
+            value = self._buffer.pop(seq)
+        else:
+            while self._results_read <= seq:
+                outs = [self._out_chans[c].read(timeout)
+                        for c in self._out_chans_names]
+                value = outs[0] if self._num_outputs == 1 else outs
+                got = self._results_read
+                self._results_read += 1
+                if got != seq:
+                    self._buffer[got] = value
+        for v in (value if isinstance(value, list) else [value]):
+            if isinstance(v, ChannelError):
+                raise RuntimeError(f"compiled DAG stage failed: {v.err}")
+            if isinstance(v, _Stop):
+                raise RuntimeError("compiled DAG torn down mid-execution")
+        return value
+
+    # -- teardown --
+
+    def teardown(self, kill_actors: bool = True):
+        from ray_tpu.dag.channels import _Stop
+
+        if self._torn_down:
+            return
+        self._torn_down = True
+        self._submit_q.put(_Stop())  # flows after any queued inputs
+        self._submit_q.put(None)  # then stop the feeder thread
+        self._submit_thread.join(timeout=30.0)
+        time.sleep(0.2)  # let loops observe the sentinel and exit
+        for ch in self._channels:
+            try:
+                ch.close(unlink=True)
+            except Exception:
+                pass
+        if kill_actors:
+            for node in self._order:
+                if isinstance(node, ClassMethodNode) \
+                        and isinstance(node._target, ClassNode) \
+                        and node._target._actor_handle is not None:
+                    try:
+                        ray_tpu.kill(node._target._actor_handle)
+                    except Exception:
+                        pass
+                    node._target._actor_handle = None

@@ -2,14 +2,20 @@
 
 Reference: python/ray/experimental/channel/shared_memory_channel.py backed by
 C++ mutable objects (core_worker/experimental_mutable_object_manager.cc —
-versioned buffers with writer/reader synchronization). TPU-native round-1
-design: a fixed-capacity /dev/shm ring slot with a seqlock header
+versioned buffers with writer/reader synchronization; the writer BLOCKS
+until every registered reader has consumed the previous value, so pipeline
+stages observe every value, reference shared_memory_channel.py:151).
 
-  [u64 version][u64 payload_len][payload bytes...]
+TPU-native design: a fixed-capacity /dev/shm slot with a seqlock header plus
+per-reader ack slots:
+
+  [u64 version][u64 payload_len][u32 num_readers][u32 pad]
+  [u64 ack[MAX_READERS]][payload bytes...]
 
 Writers bump version to odd while writing, even when done; readers spin
-until they observe a new even version and a consistent snapshot. One writer,
-N readers, single machine (cross-node channels ride the object plane).
+until they observe a new even version and a consistent snapshot, then ack
+by storing that version in their slot. One writer, up to MAX_READERS
+readers, single host (cross-host compiled graphs ride the object plane).
 """
 
 from __future__ import annotations
@@ -21,31 +27,91 @@ from typing import Any, Optional
 from ray_tpu._private.object_store import ShmSegment
 from ray_tpu._private.serialization import dumps_oob, loads_oob
 
-_HEADER = 16
+MAX_READERS = 16
+_HEADER = 24 + 8 * MAX_READERS
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class _Stop:
+    """Teardown sentinel: propagated stage to stage."""
+
+    def __reduce__(self):
+        return (_Stop, ())
+
+
+STOP = _Stop()
+
+
+class ChannelError:
+    """Error sentinel: carries a stage's exception to downstream readers."""
+
+    def __init__(self, err: str):
+        self.err = err
 
 
 class Channel:
-    """Single-writer multi-reader mutable slot."""
+    """Single-writer, acked multi-reader mutable slot.
 
-    def __init__(self, name: str, capacity: int = 1 << 20, create: bool = False):
+    The writer passes ``num_readers`` at create time; each reader attaches
+    with a distinct ``reader_slot`` in [0, num_readers). ``write`` blocks
+    until all readers have acked the previous version (backpressure), so no
+    reader ever misses a value.
+    """
+
+    def __init__(self, name: str, capacity: int = 1 << 20,
+                 create: bool = False, num_readers: int = 1,
+                 reader_slot: Optional[int] = None):
+        if num_readers > MAX_READERS:
+            raise ValueError(f"at most {MAX_READERS} readers per channel")
         self.name = f"rtpu_chan_{name}"
         self.capacity = capacity
+        self.num_readers = num_readers
+        self.reader_slot = reader_slot
         if create:
             self.seg = ShmSegment(self.name, capacity + _HEADER, create=True)
-            struct.pack_into("<QQ", self.seg.buf, 0, 0, 0)
+            self.seg.buf[:_HEADER] = b"\x00" * _HEADER
+            struct.pack_into("<I", self.seg.buf, 16, num_readers)
         else:
             self.seg = ShmSegment(self.name)
+            self.capacity = self.seg.size - _HEADER
+            self.num_readers = struct.unpack_from("<I", self.seg.buf, 16)[0]
+            if self.reader_slot is None:
+                self.reader_slot = 0  # single-reader attach convenience
         self._last_read_version = 0
+
+    # -- header accessors --
+
+    def _version(self) -> int:
+        return struct.unpack_from("<Q", self.seg.buf, 0)[0]
+
+    def _ack(self, slot: int) -> int:
+        return struct.unpack_from("<Q", self.seg.buf, 24 + 8 * slot)[0]
 
     # -- writer --
 
-    def write(self, value: Any, timeout: Optional[float] = None):
+    def write(self, value: Any, timeout: Optional[float] = 300.0):
         blob = dumps_oob(value)
         if len(blob) > self.capacity:
             raise ValueError(
                 f"channel {self.name}: value of {len(blob)}B exceeds capacity "
                 f"{self.capacity}B")
-        version = struct.unpack_from("<Q", self.seg.buf, 0)[0]
+        version = self._version()
+        if version % 2 != 0:
+            raise RuntimeError(f"channel {self.name}: concurrent writer")
+        # backpressure: every reader must have consumed the current value
+        # before it is overwritten (reader-ack; no value is ever dropped)
+        if version > 0:
+            deadline = time.monotonic() + (timeout or 300.0)
+            spins = 0
+            while any(self._ack(i) < version for i in range(self.num_readers)):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"channel {self.name}: reader did not consume value")
+                spins += 1
+                time.sleep(0 if spins < 2000 else 0.0002)
         struct.pack_into("<Q", self.seg.buf, 0, version + 1)  # odd: writing
         self.seg.buf[_HEADER : _HEADER + len(blob)] = blob
         struct.pack_into("<Q", self.seg.buf, 8, len(blob))
@@ -53,24 +119,32 @@ class Channel:
 
     # -- reader --
 
-    def read(self, timeout: float = 60.0) -> Any:
-        """Blocks until a version newer than the last read is available."""
+    def read(self, timeout: float = 300.0) -> Any:
+        """Blocks until a version newer than the last read is available,
+        then acks it (freeing the writer to produce the next value)."""
+        if self.reader_slot is None:
+            raise RuntimeError("attach with reader_slot to read")
         deadline = time.monotonic() + timeout
+        spins = 0
         while True:
-            v1 = struct.unpack_from("<Q", self.seg.buf, 0)[0]
+            v1 = self._version()
             if v1 % 2 == 0 and v1 > self._last_read_version:
                 length = struct.unpack_from("<Q", self.seg.buf, 8)[0]
                 data = bytes(self.seg.buf[_HEADER : _HEADER + length])
-                v2 = struct.unpack_from("<Q", self.seg.buf, 0)[0]
+                v2 = self._version()
                 if v1 == v2:  # consistent snapshot
                     self._last_read_version = v1
-                    return loads_oob(data)
+                    value = loads_oob(data)
+                    struct.pack_into("<Q", self.seg.buf, 24 + 8 * self.reader_slot, v1)
+                    return value
             if time.monotonic() > deadline:
                 raise TimeoutError(f"channel {self.name}: no new value")
-            time.sleep(0.0002)
+            # adaptive: spin hot briefly (hop latency ~µs), then yield
+            spins += 1
+            time.sleep(0 if spins < 2000 else 0.0002)
 
     def peek_version(self) -> int:
-        return struct.unpack_from("<Q", self.seg.buf, 0)[0]
+        return self._version()
 
     def close(self, unlink: bool = False):
         self.seg.close()
@@ -89,7 +163,7 @@ class IntraProcessChannel:
     def write(self, value, timeout=None):
         self._q.put(value, timeout=timeout)
 
-    def read(self, timeout: float = 60.0):
+    def read(self, timeout: float = 300.0):
         return self._q.get(timeout=timeout)
 
     def close(self, unlink: bool = False):

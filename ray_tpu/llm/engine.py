@@ -235,7 +235,10 @@ class JaxLLMEngine:
 
     # -- the step ----------------------------------------------------------
 
-    def step(self) -> List[RequestOutput]:
+    def step(self, decode: bool = True) -> List[RequestOutput]:
+        """One scheduling step. ``decode=False`` runs only the admit+prefill
+        phase — the prefill side of PD disaggregation (reference serving
+        pattern: serving_patterns/prefill_decode/pd_server.py:31)."""
         import jax.numpy as jnp
 
         outputs: List[RequestOutput] = []
@@ -263,7 +266,7 @@ class JaxLLMEngine:
                 self._emit(r, int(toks_np[r.slot]), outputs)
 
         # 2) one decode step for all active slots
-        if self._active.any():
+        if decode and self._active.any():
             # page-boundary allocation; preempt to waiting on exhaustion
             for req in [s for s in self._slots if s is not None]:
                 if self._active[req.slot] and not self._ensure_page(req):
@@ -308,6 +311,93 @@ class JaxLLMEngine:
         outputs.append(RequestOutput(
             req.request_id, list(req.generated), req.finished,
             req.finish_reason))
+
+    # -- PD disaggregation (KV page export / import) -----------------------
+    # Reference: serving_patterns/prefill_decode/pd_server.py + the vLLM
+    # KV-transfer connectors (engines/vllm/kv_transfer/). The paged layout
+    # makes a sequence's KV state a gather of its pages.
+
+    def prefill_only(self, request_id: str, prompt: Any,
+                     params: Optional[SamplingParams] = None,
+                     max_steps: int = 1000) -> dict:
+        """Prefill one request (emitting its first token) and export its KV
+        state; the request is then released here — a decode engine imports
+        the state and continues without re-prefilling."""
+        self.add_request(request_id, prompt, params)
+        req = self._requests[request_id]
+        for _ in range(max_steps):
+            self.step(decode=False)
+            if req.finished or req.generated:
+                break
+        else:
+            self.abort_request(request_id)
+            raise RuntimeError(f"prefill of {request_id} did not get admitted")
+        if req.finished:
+            # done at prefill (e.g. max_tokens=1): no KV to hand off
+            return {"request_id": request_id,
+                    "prompt_tokens": list(req.prompt_tokens),
+                    "generated": list(req.generated), "seq_len": 0,
+                    "finished": True, "finish_reason": req.finish_reason,
+                    "params": req.params}
+        return self.export_kv(request_id)
+
+    def export_kv(self, request_id: str) -> dict:
+        """Gather a live request's KV pages + scheduling state, releasing
+        the request locally. The blob is plain numpy: it ships over the
+        object plane (or the device-object plane when replicas colocate)."""
+        req = self._requests.get(request_id)
+        if req is None or req.slot < 0:
+            raise KeyError(f"no live request {request_id}")
+        pages = np.asarray(req.pages, np.int32)
+        state = {
+            "request_id": req.request_id,
+            "prompt_tokens": list(req.prompt_tokens),
+            "generated": list(req.generated),
+            "seq_len": int(self._seq_lens[req.slot]),
+            "finished": req.finished,
+            "finish_reason": req.finish_reason,
+            "params": req.params,
+            "k": np.asarray(self.cache.k[:, pages]),
+            "v": np.asarray(self.cache.v[:, pages]),
+        }
+        self.abort_request(request_id)
+        return state
+
+    def add_request_with_kv(self, state: dict) -> None:
+        """Admit a prefilled request directly into a decode slot: allocate
+        fresh pages, scatter the imported KV into them, and resume decoding
+        at the imported position (no re-prefill)."""
+        import jax.numpy as jnp
+
+        if state.get("finished"):
+            # finished during prefill (e.g. max_tokens=1): nothing to decode
+            raise ValueError("request already finished at prefill")
+        free_slots = [i for i, s in enumerate(self._slots) if s is None]
+        n_pages = state["k"].shape[1]
+        if not free_slots or len(self._free_pages) < n_pages:
+            raise RuntimeError("decode engine has no capacity; retry")
+        req = _Request(state["request_id"], list(state["prompt_tokens"]),
+                       state["params"])
+        req.generated = list(state["generated"])
+        req.slot = free_slots[0]
+        req.pages = [self._free_pages.popleft() for _ in range(n_pages)]
+        pages = jnp.asarray(np.asarray(req.pages, np.int32))
+        self.cache = self._mr.KVCache(
+            self.cache.k.at[:, pages].set(jnp.asarray(state["k"])),
+            self.cache.v.at[:, pages].set(jnp.asarray(state["v"])))
+        row = self._block_tables[req.slot]
+        row[:] = 0
+        row[:n_pages] = req.pages
+        self._seq_lens[req.slot] = state["seq_len"]
+        self._last_tokens[req.slot] = req.generated[-1]
+        p = req.params
+        self._temps[req.slot] = p.temperature
+        self._top_ks[req.slot] = p.top_k
+        self._top_ps[req.slot] = p.top_p
+        self._seeds[req.slot] = -1 if p.seed is None else p.seed
+        self._slots[req.slot] = req
+        self._active[req.slot] = True
+        self._requests[req.request_id] = req
 
     # -- convenience -------------------------------------------------------
 

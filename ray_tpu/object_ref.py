@@ -10,6 +10,15 @@ from typing import Optional
 
 from ray_tpu._private.ids import ObjectID
 
+# process-wide reference counter hook, installed by the connected CoreWorker
+# (reference: reference_counter.cc tracks every handle's lifetime)
+_ref_counter = None
+
+
+def set_ref_counter(rc) -> None:
+    global _ref_counter
+    _ref_counter = rc
+
 
 class ObjectRef:
     __slots__ = ("_id", "_owner_address", "__weakref__")
@@ -17,6 +26,17 @@ class ObjectRef:
     def __init__(self, object_id: ObjectID, owner_address: str = ""):
         self._id = object_id
         self._owner_address = owner_address
+        rc = _ref_counter
+        if rc is not None:
+            rc.ref_created(object_id.binary(), owner_address)
+
+    def __del__(self):
+        rc = _ref_counter
+        if rc is not None:
+            try:
+                rc.ref_deleted(self._id.binary())
+            except Exception:
+                pass  # interpreter teardown
 
     @property
     def id(self) -> ObjectID:
@@ -41,6 +61,9 @@ class ObjectRef:
         return _worker.global_worker().as_future(self)
 
     def __reduce__(self):
+        lst = getattr(_serialized_refs, "refs", None)
+        if lst is not None:
+            lst.append((self._id.binary(), self._owner_address))
         return (_rebuild_ref, (self._id.binary(), self._owner_address))
 
     def __hash__(self):
@@ -59,4 +82,42 @@ class ObjectRef:
 
 
 def _rebuild_ref(id_bytes: bytes, owner_address: str) -> ObjectRef:
+    lst = getattr(_deserialized_refs, "refs", None)
+    if lst is not None:
+        lst.append((id_bytes, owner_address))
     return ObjectRef(ObjectID(id_bytes), owner_address)
+
+
+# thread-local collector: while active, every ObjectRef serialized on this
+# thread is recorded so the caller can pin/track contained (nested) refs
+import contextlib as _contextlib
+import threading as _threading
+
+_serialized_refs = _threading.local()
+
+
+@_contextlib.contextmanager
+def collect_serialized_refs():
+    prev = getattr(_serialized_refs, "refs", None)
+    out: list = []
+    _serialized_refs.refs = out
+    try:
+        yield out
+    finally:
+        _serialized_refs.refs = prev
+
+
+_deserialized_refs = _threading.local()
+
+
+@_contextlib.contextmanager
+def collect_deserialized_refs():
+    """Record every ObjectRef rebuilt from a pickle on this thread — used by
+    executors to learn which foreign refs a task received (borrow tracking)."""
+    prev = getattr(_deserialized_refs, "refs", None)
+    out: list = []
+    _deserialized_refs.refs = out
+    try:
+        yield out
+    finally:
+        _deserialized_refs.refs = prev

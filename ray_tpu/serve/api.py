@@ -278,9 +278,12 @@ class _ServeController:
                 dead = isinstance(e, ActorDiedError) or isinstance(
                     cause, ActorDiedError) or "ActorDied" in str(e)
                 # a slow health check under load is not death: give a
-                # replica three strikes before replacing it
+                # replica several strikes before replacing it (first-request
+                # XLA compiles can starve the loop on small hosts)
+                from ray_tpu._private.config import RAY_CONFIG as _cfg
+
                 strikes[r] = strikes.get(r, 0) + 1
-                if not dead and strikes[r] < 3:
+                if not dead and strikes[r] < _cfg.serve_health_strikes:
                     alive.append(r)
                 else:
                     strikes.pop(r, None)

@@ -121,3 +121,52 @@ def test_xla_group_single_process():
     ag = np.asarray(group.allgather(np.arange(8, dtype=np.float32)))
     np.testing.assert_allclose(ag[:8], np.arange(8.0))
     assert ag.shape == (64,)
+
+
+def test_xla_group_multi_worker_spmd():
+    """Multi-controller simulation on the CPU tier: N worker actors each
+    init an XLA-backend collective group over their own virtual 8-device
+    mesh and run the SAME shard_map collective program — every controller
+    must compute the identical result (the single-host analog of SPMD over
+    ICI, where each host executes the same lowered program)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote(num_cpus=0.5)
+        class SpmdWorker:
+            def __init__(self, rank, world):
+                import ray_tpu.collective as col
+
+                col.init_collective_group(world, rank, backend="xla",
+                                          group_name="spmd")
+                self.rank = rank
+
+            def gradient_sync(self):
+                """The dp gradient-sync pattern: allreduce(AVERAGE) of a
+                sharded gradient, then reducescatter for the fsdp flavor."""
+                import numpy as np
+                import ray_tpu.collective as col
+
+                grad = np.arange(8, dtype=np.float32)
+                avg = np.asarray(col.allreduce(grad, op=ReduceOp.AVERAGE,
+                                               group_name="spmd"))
+                rs = np.asarray(col.reducescatter(
+                    np.ones((8,), np.float32), group_name="spmd"))
+                ag = np.asarray(col.allgather(
+                    np.full((8,), float(3), np.float32), group_name="spmd"))
+                return avg.tolist(), rs.tolist(), ag.shape
+
+        world = 2
+        workers = [SpmdWorker.remote(r, world) for r in range(world)]
+        outs = ray_tpu.get([w.gradient_sync.remote() for w in workers],
+                           timeout=300)
+        # every controller computed the same collective results
+        assert outs[0] == outs[1]
+        avg, rs, ag_shape = outs[0]
+        assert rs == [8.0] * 8  # psum_scatter of ones over 8 devices
+        for w in workers:
+            ray_tpu.kill(w)
+    finally:
+        ray_tpu.shutdown()

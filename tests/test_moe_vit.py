@@ -114,8 +114,14 @@ def test_vit_forward_and_train():
     assert np.mean(accs[-5:]) > 0.9, f"ViT failed to learn: {accs[-5:]}"
 
 
-def test_dryrun_includes_expert_axis():
+def test_dryrun_covers_all_parallelism_axes():
+    """The dry-run mesh plans must exercise every axis >1 across the set:
+    dp + fsdp + tp on one mesh (the real-pod shape), sp + ep on another."""
     import __graft_entry__ as g
 
-    axes = g._mesh_axes_for(8)
-    assert axes["expert"] == 2 and axes["tensor"] == 2 and axes["seq"] == 2
+    plans = g._mesh_plans_for(8)
+    assert len(plans) == 2
+    covered = {k for p in plans for k, v in p.items() if v > 1}
+    assert covered == {"data", "fsdp", "seq", "tensor", "expert"}
+    dp_mesh = plans[0]
+    assert dp_mesh["data"] == 2 and dp_mesh["fsdp"] == 2 and dp_mesh["tensor"] == 2
