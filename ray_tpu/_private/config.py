@@ -88,6 +88,9 @@ _d("max_workers_per_node", 64)
 _d("lease_spillback_max_hops", 4)
 _d("scheduler_spread_threshold", 0.5)  # hybrid policy: pack below, spread above
 _d("worker_start_timeout_s", 60.0)
+# how long a task waits for a feasible node (an autoscaler may add one)
+# before failing with a scheduling error
+_d("infeasible_task_timeout_s", 300.0)
 
 # --- object store ---
 _d("object_store_memory", 2 * 1024**3)
@@ -110,6 +113,10 @@ _d("max_lineage_bytes", 64 * 1024**2)
 # ownership-based distributed refcounting (reference: reference_counter.h:44)
 _d("distributed_refcounting", 1)
 _d("free_grace_s", 1.0)  # settle delay before a zero-ref free (in-flight borrows)
+# sustained unreachability before an owner declares a borrower dead and
+# reclaims its borrows; borrowers re-assert every 30s, so partitions shorter
+# than this are fully safe and longer ones only lose non-reconstructable data
+_d("borrower_death_timeout_s", 120.0)
 _d("borrow_debounce_s", 0.25)  # skip borrow RPCs for transient handles
 _d("max_object_reconstructions", 5)
 

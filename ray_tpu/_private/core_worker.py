@@ -96,6 +96,8 @@ class _LeasePool:
         self.resources = resources
         self.pending = deque()  # task records awaiting a pusher
         self.pushers = 0
+        self.active_leases = 0  # pushers currently holding a granted lease
+        self.busy = 0  # pushers blocked inside a PushTaskBatch round trip
         self._work = asyncio.Event()  # set while pending is non-empty
 
     def submit(self, record: dict):
@@ -106,11 +108,14 @@ class _LeasePool:
 
     def _ensure_pushers(self):
         cap = RAY_CONFIG.max_pending_lease_requests
-        # one pusher per BATCH of queued work: a 100-task burst wants ~7
-        # leases, not 16 cold worker spawns that steal the CPU the tasks need
-        want = min(max(1, (len(self.pending) + self.BATCH - 1) // self.BATCH),
-                   cap)
-        while self.pushers < want:
+        # one AVAILABLE pusher per pending task up to the cap (reference:
+        # pipelined lease requests in normal_task_submitter.cc) —
+        # parallelism first; pushers blocked mid-push on a long task don't
+        # count, or staggered long-task arrivals would serialize behind
+        # them. Tiny tasks still batch because whichever pusher is granted
+        # first drains a share of the queue per round trip.
+        want = min(max(1, len(self.pending)), cap)
+        while self.pushers - self.busy < want:
             self.pushers += 1
             asyncio.ensure_future(self._pusher())
 
@@ -119,41 +124,63 @@ class _LeasePool:
         try:
             try:
                 lease = await self._do_request()
+                if lease is None:
+                    return  # queue drained by concurrent pushers
             except Exception as e:
-                # a lease is unobtainable (infeasible / timeout): fail the
-                # queued tasks rather than wedging them
-                if self.pushers == 1:
+                # a lease is unobtainable — and since busy nodes are waited
+                # out (not errored), this means the shape stayed infeasible
+                # for the whole window (or every raylet was unreachable).
+                # If no sibling pusher holds a working lease, fail everything
+                # queued NOW with the scheduling error; with a live lease the
+                # failure is node-local (e.g. one raylet's stale PG view) and
+                # the healthy pushers keep draining the queue.
+                if self.active_leases == 0:
+                    tb = traceback.format_exc()
                     while self.pending:
                         record = self.pending.popleft()
                         self.core._complete_error(record, TaskError(
-                            f"scheduling failed for {record['name']}: {e}",
-                            traceback.format_exc()))
+                            f"scheduling failed for {record['name']}: {e}", tb))
                 return
             idle_deadline = None
-            while True:
-                batch = []
-                while self.pending and len(batch) < self.BATCH:
-                    batch.append(self.pending.popleft())
-                if not batch:
-                    self._work.clear()
-                    if self.pending:  # a submit raced the clear
+            self.active_leases += 1
+            try:
+                while True:
+                    # divide the queue across ALL pushers (not just granted
+                    # leases): soon-to-be-granted pushers must find work
+                    # left, or long tasks serialize onto the first lease.
+                    # On a saturated cluster this degrades to small batches,
+                    # where push round trips are not the bottleneck anyway.
+                    share = -(-len(self.pending) // max(1, self.pushers))
+                    take = max(1, min(self.BATCH, share))
+                    batch = []
+                    while self.pending and len(batch) < take:
+                        batch.append(self.pending.popleft())
+                    if not batch:
+                        self._work.clear()
+                        if self.pending:  # a submit raced the clear
+                            continue
+                        if idle_deadline is None:
+                            idle_deadline = time.monotonic() + _LEASE_IDLE_S
+                        remaining = idle_deadline - time.monotonic()
+                        if remaining <= 0:
+                            await self.core._drop_lease(lease)
+                            return
+                        try:
+                            await asyncio.wait_for(self._work.wait(), remaining)
+                        except asyncio.TimeoutError:
+                            pass
                         continue
-                    if idle_deadline is None:
-                        idle_deadline = time.monotonic() + _LEASE_IDLE_S
-                    remaining = idle_deadline - time.monotonic()
-                    if remaining <= 0:
+                    idle_deadline = None
+                    self.busy += 1
+                    try:
+                        ok = await self._push_batch(lease, batch)
+                    finally:
+                        self.busy -= 1
+                    if not ok:
                         await self.core._drop_lease(lease)
                         return
-                    try:
-                        await asyncio.wait_for(self._work.wait(), remaining)
-                    except asyncio.TimeoutError:
-                        pass
-                    continue
-                idle_deadline = None
-                ok = await self._push_batch(lease, batch)
-                if not ok:
-                    await self.core._drop_lease(lease)
-                    return
+            finally:
+                self.active_leases -= 1
         finally:
             self.pushers -= 1
             if self.pending:
@@ -200,6 +227,11 @@ class _LeasePool:
         return True
 
     async def _do_request(self) -> dict:
+        """Acquire one lease. Busy nodes are waited out for as long as the
+        shape stays feasible-by-totals (the reference queues leases at the
+        raylet, cluster_lease_manager.cc — a saturated cluster must queue,
+        not error); only a shape no node can EVER satisfy (PickNode exhausts
+        infeasible_task_timeout_s) or a cluster-wide unreachability raises."""
         opts, resources = self.opts, self.resources
         node = await self.core._pick_node(opts, resources)
         if node is None:
@@ -214,8 +246,15 @@ class _LeasePool:
             "bundle_index": opts.placement_group_bundle_index,
             "runtime_env": opts.runtime_env,
         }
-        deadline = time.monotonic() + RAY_CONFIG.worker_start_timeout_s * 4
+        unreachable_deadline = None
+        infeasible_since = None
+        busy_delay = 0.1
         while True:
+            if not self.pending:
+                # the queue drained while we were acquiring (other pushers
+                # served it): stand down instead of spinning and emitting
+                # phantom autoscaler demand for work that no longer exists
+                return None
             try:
                 reply = pickle.loads(await raylet.call(
                     "RequestWorkerLease", pickle.dumps(req),
@@ -224,7 +263,10 @@ class _LeasePool:
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 # raylet unreachable (node died between pick and lease):
                 # re-pick a node until the GCS view catches up
-                if time.monotonic() > deadline:
+                if unreachable_deadline is None:
+                    unreachable_deadline = (
+                        time.monotonic() + RAY_CONFIG.worker_start_timeout_s * 4)
+                if time.monotonic() > unreachable_deadline:
                     raise RuntimeError(f"lease request kept failing: {e}")
                 await asyncio.sleep(0.5)
                 node2 = await self.core._pick_node(opts, resources)
@@ -232,19 +274,39 @@ class _LeasePool:
                     node = node2
                     raylet = self.core._raylet_client(node["address"])
                 continue
+            unreachable_deadline = None
             if reply["status"] == "granted":
                 return {"key": self.key, "lease_id": reply["lease_id"],
                         "worker_address": reply["worker_address"],
                         "raylet_address": node["address"],
                         "last_used": time.monotonic()}
-            if time.monotonic() > deadline:
-                raise RuntimeError(f"lease request kept failing: {reply['status']}")
+            if reply["status"] == "infeasible":
+                # the raylet's totals reject a shape the GCS view accepts
+                # (e.g. stale PG bundle after a raylet restart): bounded —
+                # a permanent disagreement must error, not loop forever
+                if infeasible_since is None:
+                    infeasible_since = time.monotonic()
+                elif time.monotonic() - infeasible_since > \
+                        RAY_CONFIG.infeasible_task_timeout_s:
+                    raise RuntimeError(
+                        f"raylet reports resources={resources} infeasible")
+            else:
+                infeasible_since = None
             if reply["status"] in ("busy", "infeasible"):
+                # re-pick; a transient None (PG/affinity nodes briefly
+                # absent from the GCS view) keeps the current raylet —
+                # persistent disagreement is bounded by infeasible_since.
+                # Backoff: saturation can last hours; 16 pushers polling at
+                # 10 Hz each would hammer the GCS for nothing (the raylet
+                # lease call itself already parks ~worker_start_timeout_s)
                 node2 = await self.core._pick_node(opts, resources)
                 if node2 is not None and node2["address"] != node["address"]:
                     node = node2
                     raylet = self.core._raylet_client(node["address"])
-                await asyncio.sleep(0.1)
+                await asyncio.sleep(busy_delay)
+                busy_delay = min(busy_delay * 1.5, 2.0)
+            else:
+                busy_delay = 0.1
 
 
 class CoreWorker:
@@ -288,7 +350,12 @@ class CoreWorker:
         # ownership refcounting (reference: reference_counter.h:44)
         self.ref_counter = ReferenceCounter(lambda: self.address)
         self._free_pending: set = set()
-        self._registered_borrows: set = set()
+        # owner-initiated borrow tracking (reference: WaitForRefRemoved in
+        # reference_counter.cc): per borrower address, {oid: generation}
+        # being watched by a long-poll loop — the generation fences stale
+        # done-replies against concurrent re-registrations
+        self._borrow_watch_sets: Dict[str, Dict[bytes, int]] = {}
+        self._borrow_watch_active: set = set()
         self._lease_cache: Dict[tuple, List[dict]] = {}
         self._renv_prepared: Dict[str, dict] = {}
         self.job_runtime_env: Optional[dict] = None
@@ -345,7 +412,6 @@ class CoreWorker:
             from ray_tpu import object_ref as object_ref_mod
 
             self.ref_counter.on_owned_zero = self._on_owned_zero
-            self.ref_counter.on_borrow_zero = self._on_borrow_zero
             self.ref_counter.on_borrow_first = self._on_borrow_first
             object_ref_mod.set_ref_counter(self.ref_counter)
             # periodic drain of the __del__-safe deletion queue (refs dropped
@@ -354,12 +420,39 @@ class CoreWorker:
         return self
 
     async def _refcount_sweep(self):
+        last_reassert = time.monotonic()
         while not self._shutdown:
             try:
                 self.ref_counter.flush_deletes()
+                if time.monotonic() - last_reassert > 30.0:
+                    last_reassert = time.monotonic()
+                    # fire-and-track: an unreachable owner (10s timeout
+                    # each) must not stall the 0.2s flush cadence
+                    asyncio.ensure_future(self._reassert_borrows())
             except Exception:
                 logger.exception("refcount sweep failed")
             await asyncio.sleep(0.2)
+
+    async def _reassert_borrows(self):
+        """Periodically re-register still-held foreign borrows with their
+        owners (bulk, idempotent): heals an owner that wrongly reclaimed a
+        live borrower after a transient partition."""
+        by_owner: Dict[str, list] = {}
+        for oid, owner in self.ref_counter.borrowed_held():
+            by_owner.setdefault(owner, []).append(oid)
+
+        async def _one(owner, oids):
+            try:
+                await self._worker_client(owner).call(
+                    "AddBorrowers", pickle.dumps(
+                        {"oids": oids, "address": self.address}),
+                    timeout=10.0, retries=1)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass  # next sweep retries; the owner may simply be gone
+
+        # concurrent: one slow/dead owner must not delay re-asserts to the
+        # reachable ones while their death-timeout clocks run
+        await asyncio.gather(*[_one(o, oids) for o, oids in by_owner.items()])
 
     async def _connect(self):
         self.server = RpcServer(self._handle_rpc)
@@ -897,40 +990,91 @@ class CoreWorker:
             pass
 
     async def _register_borrow(self, oid: bytes, owner: str):
+        """Tell the owner we hold a borrow. Retries until acked (never a
+        silent drop — a lost registration means the owner frees an object a
+        live borrower still needs); removal is owner-initiated via the
+        WaitBorrowsDone watch, so there is no add/remove ordering race."""
         rc = self.ref_counter
-        if rc.local_count(oid) <= 0 or oid in self._registered_borrows:
+        delay = 0.1
+        for _ in range(8):
+            if rc.held_count(oid) <= 0 or self._shutdown:
+                return
+            try:
+                await self._worker_client(owner).call("AddBorrower", pickle.dumps(
+                    {"oid": oid, "address": self.address}),
+                    timeout=10.0, retries=1)
+                return
+            except (RpcError, asyncio.TimeoutError, OSError):
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 5.0)
+        logger.warning("borrow registration for %s with owner %s kept "
+                       "failing; object may be freed under us",
+                       ObjectID(oid).hex()[:12], owner)
+
+    # -- owner side: borrow lifetime watches (reference: WaitForRefRemoved,
+    # reference_counter.cc — the owner subscribes to each borrower and drops
+    # the borrow when the borrower reports release OR becomes unreachable) --
+
+    def _watch_borrower(self, oid: bytes, addr: str):
+        if not addr or addr == self.address or self._shutdown:
             return
-        self._registered_borrows.add(oid)
+        watch = self._borrow_watch_sets.setdefault(addr, {})
+        watch[oid] = watch.get(oid, 0) + 1  # new registration generation
+        if addr not in self._borrow_watch_active:
+            self._borrow_watch_active.add(addr)
+            asyncio.ensure_future(self._borrow_watch_loop(addr))
+
+    async def _borrow_watch_loop(self, addr: str):
+        """One long-poll loop per borrower address covering all its borrowed
+        oids; a dead borrower (sustained unreachability, ~1 min of strikes)
+        releases everything. Borrowers also periodically re-assert held
+        borrows (_reassert_borrows), so a wrongly-reclaimed live borrower
+        re-registers unless the object was already freed in the gap."""
+        failing_since = None
+        delay = 1.0
         try:
-            await self._worker_client(owner).call("AddBorrower", pickle.dumps(
-                {"oid": oid, "address": self.address}), timeout=10.0, retries=1)
-        except (RpcError, asyncio.TimeoutError, OSError):
-            pass
-
-    def _on_borrow_zero(self, oid: bytes, owner: str):
-        if self._shutdown:
-            return
-
-        def _later():
-            self.loop.call_later(
-                RAY_CONFIG.borrow_debounce_s,
-                lambda: asyncio.ensure_future(self._unregister_borrow(oid, owner)))
-
-        try:
-            self.loop.call_soon_threadsafe(_later)
-        except RuntimeError:
-            pass
-
-    async def _unregister_borrow(self, oid: bytes, owner: str):
-        rc = self.ref_counter
-        if rc.local_count(oid) > 0 or oid not in self._registered_borrows:
-            return
-        self._registered_borrows.discard(oid)
-        try:
-            await self._worker_client(owner).call("RemoveBorrower", pickle.dumps(
-                {"oid": oid, "address": self.address}), timeout=10.0, retries=1)
-        except (RpcError, asyncio.TimeoutError, OSError):
-            pass
+            while not self._shutdown:
+                snap = dict(self._borrow_watch_sets.get(addr, {}))
+                if not snap:
+                    return
+                try:
+                    reply = pickle.loads(await self._worker_client(addr).call(
+                        "WaitBorrowsDone",
+                        pickle.dumps({"oids": list(snap)}),
+                        timeout=40.0, retries=0, connect_timeout=5.0))
+                    failing_since = None
+                    delay = 1.0
+                    done = reply["done"]
+                except RpcApplicationError:
+                    # the borrower REPLIED (it is alive) — a handler error
+                    # is not a death signal; keep watching
+                    await asyncio.sleep(1.0)
+                    continue
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    now = time.monotonic()
+                    if failing_since is None:
+                        failing_since = now
+                    if now - failing_since < RAY_CONFIG.borrower_death_timeout_s:
+                        await asyncio.sleep(delay)
+                        delay = min(delay * 2, 10.0)
+                        continue
+                    done = list(snap)  # borrower dead: reclaim its borrows
+                watch = self._borrow_watch_sets.get(addr, {})
+                for oid in done:
+                    if watch.get(oid) != snap.get(oid):
+                        continue  # re-registered while the probe was out
+                    watch.pop(oid, None)
+                    self.ref_counter.remove_borrower(oid, addr)
+        finally:
+            self._borrow_watch_active.discard(addr)
+            rest = self._borrow_watch_sets.get(addr)
+            if not rest:
+                self._borrow_watch_sets.pop(addr, None)
+            elif not self._shutdown:
+                # respawn covers exceptions / adds that raced the exit;
+                # re-assert the existing generation rather than minting one
+                self._borrow_watch_active.add(addr)
+                asyncio.ensure_future(self._borrow_watch_loop(addr))
 
     def _register_lineage(self, task_id: TaskID, record: dict):
         """Retain the task record for reconstruction while its outputs are
@@ -991,10 +1135,11 @@ class CoreWorker:
 
     def _process_reply_refs(self, reply: dict, executor_addr: str):
         """Handle borrow/nested-ref reports carried on a task reply (the
-        protocol replacing the reference's borrower-chain handshake)."""
+        reliable registration leg; removal is owner-initiated via watches)."""
         for oid, owner in reply.get("borrows", ()):
             if not owner or owner == self.address:
                 self.ref_counter.add_borrower(oid, executor_addr)
+                self._watch_borrower(oid, executor_addr)
             else:
                 asyncio.ensure_future(self._forward_borrow(owner, oid, executor_addr))
         nested = reply.get("nested") or {}
@@ -1120,12 +1265,27 @@ class CoreWorker:
             blob = pack_blob(*serialize((args, kwargs)))
         return blob, arg_refs
 
+    async def _resolve_dependencies(self, record: dict):
+        """Wait for locally-owned ref args to finish producing before the
+        task becomes push-eligible (reference: dependency_resolver.cc, used
+        by normal_task_submitter.cc:32). This keeps batched pushes
+        dependency-safe: a task can never ride the same PushTaskBatch as its
+        own producer, whose result would otherwise be trapped in the batch's
+        unreturned reply."""
+        for oid_b, owner in record.get("arg_refs", ()):
+            if owner and owner != self.address:
+                continue  # foreign-owned: the executor resolves via that owner
+            fut = self._result_futures.get(ObjectID(oid_b))
+            if fut is not None and not fut.done():
+                await asyncio.shield(fut)
+
     async def _drive_task(self, record: dict):
         """Queue onto the scheduling-key pool (lease reuse + batched pushes;
         reference: normal_task_submitter.cc + task_manager.cc) and wait for
         completion. Retries on worker failure happen inside the pool."""
         spec: TaskSpec = record["spec"]
         opts: TaskOptions = spec.options
+        await self._resolve_dependencies(record)
         pool = self._lease_pool_for(opts, opts.required_resources())
         record["_done"] = asyncio.Event()
         pool.submit(record)
@@ -1214,7 +1374,7 @@ class CoreWorker:
                 self._spread_hint += 1
                 req["strategy"] = "SPREAD"
                 req["spread_hint"] = self._spread_hint
-        deadline = time.monotonic() + 300.0
+        deadline = time.monotonic() + RAY_CONFIG.infeasible_task_timeout_s
         warned = False
         # one demand unit per concurrent pick, stable across its retries, so
         # the GCS autoscaler view counts waiters rather than poll attempts
@@ -1456,11 +1616,33 @@ class CoreWorker:
         if method == "AddBorrower":
             req = pickle.loads(payload)
             self.ref_counter.add_borrower(req["oid"], req["address"])
+            self._watch_borrower(req["oid"], req["address"])
+            return pickle.dumps({"status": "ok"})
+        if method == "AddBorrowers":
+            # bulk re-assert from a borrower's periodic sweep
+            req = pickle.loads(payload)
+            for oid in req["oids"]:
+                self.ref_counter.add_borrower(oid, req["address"])
+                self._watch_borrower(oid, req["address"])
             return pickle.dumps({"status": "ok"})
         if method == "RemoveBorrower":
+            # legacy/no-op-compatible explicit release (owner watches are
+            # the primary removal path)
             req = pickle.loads(payload)
             self.ref_counter.remove_borrower(req["oid"], req["address"])
             return pickle.dumps({"status": "ok"})
+        if method == "WaitBorrowsDone":
+            # borrower side of the owner's watch: long-poll until any of
+            # the probed oids is fully released here
+            req = pickle.loads(payload)
+            deadline = time.monotonic() + 25.0
+            while True:
+                self.ref_counter.flush_deletes()
+                done = [o for o in req["oids"]
+                        if self.ref_counter.held_count(o) <= 0]
+                if done or self._shutdown or time.monotonic() > deadline:
+                    return pickle.dumps({"done": done})
+                await asyncio.sleep(0.2)
         if method == "Ping":
             return pickle.dumps({"status": "ok", "pid": os.getpid()})
         if method == "GetDeviceObject":
@@ -1525,6 +1707,13 @@ class CoreWorker:
                 except asyncio.TimeoutError:
                     pass
                 continue
+            if fut is None:
+                # unknown everywhere: the object was freed (refs+borrowers
+                # hit zero) or never existed — error beats an eternal poll
+                err = ObjectLostError(
+                    f"object {oid.hex()} was freed by its owner")
+                return pickle.dumps({"status": "error",
+                                     "error": pickle.dumps(err)})
             return pickle.dumps({"status": "pending"})
 
     async def _handle_push_task(self, spec: TaskSpec) -> bytes:
@@ -1608,10 +1797,14 @@ class CoreWorker:
         """Foreign refs from the args that are still held in this process
         after execution — reported on the reply so the owner registers this
         worker as a borrower (reference: GetAndClearBorrowedRefs)."""
+        # the `del args, kwargs` decrements are still queued on the __del__-
+        # safe deletion queue: flush them first, or every arg ref would
+        # report as still held and pin its object on the owner forever
+        self.ref_counter.flush_deletes()
         out = []
         for oid, owner in {(o, w) for o, w in seen_refs}:
             if owner and owner != self.address \
-                    and self.ref_counter.local_count(oid) > 0:
+                    and self.ref_counter.held_count(oid) > 0:
                 out.append((oid, owner))
         return out
 

@@ -43,7 +43,26 @@ class ShmSegment:
         self.name = name
         self.path = f"/dev/shm/{name}"
         if create:
-            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            except FileExistsError:
+                # names are single-writer per session: an existing file is a
+                # stale leftover from a dead session — reclaim the name,
+                # but only if it is old enough (a twin may be between its
+                # create and mmap, invisible in /proc) AND no live process
+                # maps it: a split-brain twin collides loudly instead of
+                # being silently corrupted
+                try:
+                    age = time.time() - os.stat(self.path).st_mtime
+                except FileNotFoundError:
+                    age = 1e9  # a racing reclaimer already removed it
+                if age < 10.0 or _shm_mapped_by_live_process(name):
+                    raise
+                try:
+                    os.unlink(self.path)
+                except FileNotFoundError:
+                    pass  # racing reclaimer won; the create below retries
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
             os.ftruncate(fd, size)
         else:
             fd = os.open(self.path, os.O_RDWR)
@@ -65,6 +84,58 @@ class ShmSegment:
             os.unlink(self.path)
         except FileNotFoundError:
             pass
+
+
+def _shm_mapped_by_live_process(name: str) -> bool:
+    """True when any live process maps /dev/shm/<name> (scans /proc)."""
+    import glob
+
+    needle = "/dev/shm/" + name
+    for maps in glob.glob("/proc/[0-9]*/maps"):
+        try:
+            with open(maps) as f:
+                for line in f:
+                    if needle in line:
+                        return True
+        except OSError:
+            continue
+    return False
+
+
+def sweep_stale_shm(prefix: str = "rtpu_", min_age_s: float = 10.0) -> int:
+    """Remove /dev/shm segments left behind by dead sessions. A segment is
+    stale when no live process maps it (scanned via /proc/*/maps) and it is
+    older than ``min_age_s`` (guards the create→mmap window of a concurrent
+    session). Run at node start (reference: plasma unlinks its store file on
+    startup)."""
+    import glob
+
+    live = set()
+    for maps in glob.glob("/proc/[0-9]*/maps"):
+        try:
+            with open(maps) as f:
+                for line in f:
+                    idx = line.find("/dev/shm/" + prefix)
+                    if idx >= 0:
+                        live.add(line[idx + 9:].split()[0])
+        except OSError:
+            continue
+    removed = 0
+    now = time.time()
+    me = os.getuid()
+    for path in glob.glob(f"/dev/shm/{prefix}*"):
+        try:
+            st = os.stat(path)
+            # never touch another user's segments: their /proc/*/maps may
+            # be unreadable to us, making liveness undecidable
+            if st.st_uid != me or os.path.basename(path) in live or \
+                    now - st.st_mtime < min_age_s:
+                continue
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            pass
+    return removed
 
 
 def plan_layout(inband: bytes, buffers: List[memoryview]) -> Tuple[int, List[int]]:

@@ -650,8 +650,21 @@ def main():
             with open(tmp, "w") as f:
                 f.write(addr)
             os.replace(tmp, args.address_file)
-        await asyncio.Event().wait()
+        # graceful stop on SIGTERM/SIGINT so the store's shm arena and
+        # per-object segments are unlinked (kill -9 leftovers are reclaimed
+        # by sweep_stale_shm at the next node start)
+        stop_ev = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop_ev.set)
+        await stop_ev.wait()
+        await raylet.stop()
 
+    from ray_tpu._private.object_store import sweep_stale_shm
+
+    swept = sweep_stale_shm()
+    if swept:
+        logger.info("swept %d stale shm segments", swept)
     asyncio.run(run())
 
 

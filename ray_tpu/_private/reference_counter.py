@@ -55,9 +55,9 @@ class ReferenceCounter:
         # self-deadlock. __del__ only appends here (deque.append is
         # GIL-atomic and reentrancy-safe); normal entry points drain it.
         self._pending_deletes: "collections.deque" = collections.deque()
-        # zero-transition sinks, installed by the core worker
+        # zero-transition sink, installed by the core worker (borrow release
+        # needs no sink: the OWNER observes it via its WaitBorrowsDone watch)
         self.on_owned_zero: Optional[Callable[[bytes], None]] = None
-        self.on_borrow_zero: Optional[Callable[[bytes, str], None]] = None
         # fired when a foreign-owned oid is first held here (0 -> 1)
         self.on_borrow_first: Optional[Callable[[bytes, str], None]] = None
 
@@ -108,17 +108,11 @@ class ReferenceCounter:
         me = self._my_address()
         if not c.owner or c.owner == me:
             return "owned" if not c.borrowers else None
-        return "borrowed"
+        return None  # borrow release: the owner's watch observes it
 
     def _fire(self, kind: Optional[str], oid: bytes):
         if kind == "owned" and self.on_owned_zero is not None:
             self.on_owned_zero(oid)
-        elif kind == "borrowed" and self.on_borrow_zero is not None:
-            with self._lock:
-                c = self._counts.get(oid)
-                owner = c.owner if c else ""
-            if owner:
-                self.on_borrow_zero(oid, owner)
 
     # -- pins (handover / nesting; io loop or any thread) --
 
@@ -208,6 +202,22 @@ class ReferenceCounter:
         with self._lock:
             c = self._counts.get(oid)
             return 0 if c is None else c.local
+
+    def held_count(self, oid: bytes) -> int:
+        """Live handles + pins: what a borrow-done probe must see as zero
+        (nested pins keep a borrow alive without any ObjectRef instance)."""
+        with self._lock:
+            c = self._counts.get(oid)
+            return 0 if c is None else max(c.local, 0) + max(c.pins, 0)
+
+    def borrowed_held(self) -> List[Tuple[bytes, str]]:
+        """Foreign-owned oids this process still holds — the set a borrower
+        periodically re-asserts with its owners (heals wrong reclaims)."""
+        me = self._my_address()
+        with self._lock:
+            return [(oid, c.owner) for oid, c in self._counts.items()
+                    if c.owner and c.owner != me
+                    and (c.local > 0 or c.pins > 0)]
 
     def lineage_count(self, oid: bytes) -> int:
         with self._lock:

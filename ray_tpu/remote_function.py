@@ -27,6 +27,12 @@ def build_task_options(defaults: TaskOptions, overrides: Dict[str, Any]) -> Task
         opts.placement_group_bundle_index = getattr(
             strat, "placement_group_bundle_index", -1
         )
+    if opts.runtime_env:
+        # validate HERE (decoration / .options() time), once — not per
+        # .remote() in the submit hot loop; invalid envs raise to the user
+        from ray_tpu._private import runtime_env as renv_mod
+
+        opts.runtime_env = renv_mod.normalize(opts.runtime_env)
     return opts
 
 

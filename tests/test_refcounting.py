@@ -177,7 +177,7 @@ def test_lineage_reconstruction_after_node_death():
         cluster.remove_node(node_b)
         time.sleep(1.0)
         cluster.add_node(resources={"CPU": 2.0, "zone_b": 2.0})
-        cluster.wait_for_nodes(3)
+        cluster.wait_for_nodes(2)  # head + replacement (the killed node may already be marked dead)
         assert ray_tpu.get(consume.remote(ref), timeout=180) == 42.0
     finally:
         ray_tpu.shutdown()
@@ -210,7 +210,7 @@ def test_lineage_reconstruction_recursive():
         cluster.remove_node(node_b)  # both copies gone
         time.sleep(1.0)
         cluster.add_node(resources={"CPU": 2.0, "zone_b": 2.0})
-        cluster.wait_for_nodes(3)
+        cluster.wait_for_nodes(2)  # head + replacement (the killed node may already be marked dead)
 
         @ray_tpu.remote(num_cpus=0.1)
         def consume(arr):
