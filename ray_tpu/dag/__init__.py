@@ -415,7 +415,27 @@ class CompiledDAG:
         self._submit_q.put(_Stop())  # flows after any queued inputs
         self._submit_q.put(None)  # then stop the feeder thread
         self._submit_thread.join(timeout=30.0)
-        time.sleep(0.2)  # let loops observe the sentinel and exit
+        # drain the output channels until the sentinel arrives on each:
+        # this acks the final stage's _Stop write (so its loop thread exits
+        # instead of spinning in an ack wait for its full timeout) and
+        # proves propagation through every stage before unlinking
+        deadline = time.monotonic() + 30.0
+        pending_out = set(self._out_chans_names)
+        last_progress = time.monotonic()
+        while pending_out and time.monotonic() < deadline:
+            progressed = False
+            for c in list(pending_out):
+                try:
+                    v = self._out_chans[c].read(timeout=1.0)
+                except Exception:
+                    continue  # nothing yet, or the writer already died
+                progressed = True
+                if isinstance(v, _Stop):
+                    pending_out.discard(c)
+            if progressed:
+                last_progress = time.monotonic()
+            elif time.monotonic() - last_progress > 3.0:
+                break  # a dead stage will never flush its sentinel
         for ch in self._channels:
             try:
                 ch.close(unlink=True)

@@ -14,6 +14,7 @@ the device-object transport when replicas colocate.
 
 from __future__ import annotations
 
+import time
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -144,28 +145,59 @@ def build_pd_openai_app(config: LLMConfig, params: Any = None,
 
 class DPRankAssigner:
     """Named actor handing out dense dp ranks to engine replicas
-    (reference: dp_rank_assigner.py:14)."""
+    (reference: dp_rank_assigner.py:14). Ranks are LEASES: replicas renew
+    periodically, and a rank whose holder stopped renewing (controller
+    replaced the replica, worker died) is evicted so the replacement can
+    claim a slot — without this, dp serving cannot survive replica churn."""
+
+    LEASE_TTL_S = 60.0
 
     def __init__(self, dp_size: int):
+        import time as _time
+
         self.dp_size = dp_size
+        self._time = _time
         self._next = 0
         self._ranks: Dict[str, int] = {}
+        self._last_seen: Dict[str, float] = {}
+
+    def _evict_expired(self):
+        now = self._time.time()
+        for rid in [r for r, ts in self._last_seen.items()
+                    if now - ts > self.LEASE_TTL_S]:
+            self._ranks.pop(rid, None)
+            self._last_seen.pop(rid, None)
 
     def assign(self, replica_id: str) -> int:
+        now = self._time.time()
         if replica_id in self._ranks:
+            self._last_seen[replica_id] = now
             return self._ranks[replica_id]
         if self._next >= self.dp_size:
-            # restarted replica re-uses the lowest freed rank slot
+            self._evict_expired()
+            # restarted/replacement replica re-uses the lowest freed slot
             used = set(self._ranks.values())
             for r in range(self.dp_size):
                 if r not in used:
                     self._ranks[replica_id] = r
+                    self._last_seen[replica_id] = now
                     return r
             raise RuntimeError(f"all {self.dp_size} dp ranks assigned")
         rank = self._next
         self._next += 1
         self._ranks[replica_id] = rank
+        self._last_seen[replica_id] = now
         return rank
+
+    def renew(self, replica_id: str) -> bool:
+        if replica_id not in self._ranks:
+            return False  # evicted: the replica should re-assign
+        self._last_seen[replica_id] = self._time.time()
+        return True
+
+    def release(self, replica_id: str) -> None:
+        self._ranks.pop(replica_id, None)
+        self._last_seen.pop(replica_id, None)
 
     def ranks(self) -> Dict[str, int]:
         return dict(self._ranks)
@@ -186,6 +218,28 @@ class DPLLMServer:
             assigner = ray_tpu.get_actor(assigner_name)
             self.dp_rank = ray_tpu.get(
                 assigner.assign.remote(self.replica_id), timeout=60)
+            # keep the rank lease alive (a dead replica's lease expires and
+            # its slot is recycled for the controller's replacement)
+            import threading
+
+            def _renew_loop():
+                while not getattr(self, "_stopped", False):
+                    time.sleep(DPRankAssigner.LEASE_TTL_S / 4)
+                    try:
+                        ok = ray_tpu.get(
+                            assigner.renew.remote(self.replica_id),
+                            timeout=30)
+                        if not ok:
+                            # evicted while we were unreachable: re-assign
+                            # (possibly a NEW rank — the old slot may have
+                            # been handed to our replacement already)
+                            self.dp_rank = ray_tpu.get(
+                                assigner.assign.remote(self.replica_id),
+                                timeout=30)
+                    except Exception:
+                        pass  # assigner briefly unavailable; retry next tick
+            threading.Thread(target=_renew_loop, daemon=True,
+                             name="dp-rank-renew").start()
 
     async def __call__(self, body: dict) -> dict:
         out = await self._inner(body)
@@ -206,8 +260,11 @@ def build_dp_openai_app(config: LLMConfig, dp_size: int, params: Any = None
 
         params_blob = cloudpickle.dumps(params)
     assigner_name = f"dp_assigner:{config.model_id}"
+    # get-or-create: a redeploy must reuse the existing detached assigner
+    # instead of silently colliding on the name
     ray_tpu.remote(num_cpus=0.1)(DPRankAssigner).options(
-        name=assigner_name, lifetime="detached").remote(dp_size)
+        name=assigner_name, lifetime="detached",
+        get_if_exists=True).remote(dp_size)
     dep = serve_api.deployment(
         DPLLMServer, name=f"llm-dp:{config.model_id}", num_replicas=dp_size,
         max_ongoing_requests=config.engine_config.max_num_seqs * 2,

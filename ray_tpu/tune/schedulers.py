@@ -148,10 +148,17 @@ class PopulationBasedTraining:
                  perturbation_interval: int = 4,
                  hyperparam_mutations: Optional[Dict[str, Any]] = None,
                  quantile_fraction: float = 0.25, seed: int = 0,
-                 time_attr: str = "training_iteration"):
+                 time_attr: str = "training_iteration",
+                 synch: bool = True):
         self.metric = metric
         self.mode = mode
         self.interval = perturbation_interval
+        # synchronized PBT (reference: pbt.py synch=True): trials rendezvous
+        # at perturbation boundaries so exploit decisions always see the
+        # whole population — without it, fast trials finish before slow
+        # ones even start and no exploit can ever fire. Deviation from the
+        # reference: synch defaults ON (the deterministic mode).
+        self.synch_interval = perturbation_interval if synch else None
         self.mutations = hyperparam_mutations or {}
         self.quantile = quantile_fraction
         self.time_attr = time_attr

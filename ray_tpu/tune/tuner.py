@@ -94,34 +94,61 @@ class _ReportHub:
         self.scheduler = cloudpickle.loads(scheduler_blob)
         self.latest: Dict[str, Dict] = {}
         self.iters: Dict[str, int] = {}
+        self.registered: set = set()
+        self.finished: set = set()
         # report() runs on the actor's thread pool (max_concurrency > 1);
-        # schedulers iterate shared dicts, so serialize their callbacks
+        # schedulers iterate shared dicts, so serialize their callbacks.
+        # The condition variable implements synchronized-PBT rendezvous.
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
 
     def register_trial(self, trial_id: str, config: Dict):
         # PBT needs trial configs for exploit mutation
-        with self._lock:
+        with self._cv:
+            self.registered.add(trial_id)
+            self.finished.discard(trial_id)  # exploit relaunch
             hook = getattr(self.scheduler, "register_trial", None)
             if hook is not None:
                 hook(trial_id, config)
+            self._cv.notify_all()
+        return True
+
+    def finish_trial(self, trial_id: str):
+        """A trial completed or errored: release any rendezvous waiters."""
+        with self._cv:
+            self.finished.add(trial_id)
+            self._cv.notify_all()
         return True
 
     def report(self, trial_id: str, metrics: Dict, checkpoint=None):
-        with self._lock:
+        with self._cv:
             self.iters[trial_id] = self.iters.get(trial_id, 0) + 1
+            t = self.iters[trial_id]
             metrics = dict(metrics)
-            metrics.setdefault("training_iteration", self.iters[trial_id])
+            metrics.setdefault("training_iteration", t)
             self.latest[trial_id] = metrics
             if checkpoint is not None:
                 hook = getattr(self.scheduler, "record_checkpoint", None)
                 if hook is not None:
                     hook(trial_id, checkpoint)
+            sync_t = getattr(self.scheduler, "synch_interval", None)
+            if sync_t and t % sync_t == 0:
+                # synchronized PBT: wait until every live trial reached this
+                # boundary (or finished) so the decision sees the whole
+                # population; bounded so a crashed trial can't wedge us
+                def _ready():
+                    return all(self.iters.get(tid, 0) >= t
+                               or tid in self.finished
+                               for tid in self.registered)
+
+                self._cv.notify_all()
+                self._cv.wait_for(_ready, timeout=60.0)
             return self.scheduler.on_result(trial_id, metrics)
 
-    def reset_iters(self, trial_id: str):
-        """An exploited trial restarts its iteration counter."""
-        self.iters.pop(trial_id, None)
-        return True
+    # NOTE: exploited trials do NOT reset their iteration counter — the
+    # count is total iterations executed by the trial slot, so perturbation
+    # boundaries (t % interval) stay aligned across the population and the
+    # synch rendezvous is not desynchronized by a routine exploit.
 
     def get_latest(self):
         return dict(self.latest)
@@ -187,7 +214,11 @@ class Tuner:
             searcher = BasicVariantSearcher(self.param_space, tc.num_samples,
                                             tc.seed)
         hub = _ReportHub.options(
-            name=f"tune_hub_{uuid.uuid4().hex[:8]}", max_concurrency=16,
+            # every RUNNING trial may hold one hub thread at a synch
+            # rendezvous; size the pool so waiters can never starve the
+            # report() that would release them
+            name=f"tune_hub_{uuid.uuid4().hex[:8]}",
+            max_concurrency=max(16, tc.max_concurrent_trials + 4),
         ).remote(cloudpickle.dumps(scheduler))
         fn_blob = cloudpickle.dumps(self.trainable)
 
@@ -230,6 +261,7 @@ class Tuner:
                 try:
                     out = ray_tpu.get(ref, timeout=60)
                 except TaskError as e:
+                    ray_tpu.get(hub.finish_trial.remote(trial_id), timeout=60)
                     cfg_clean = {k: v for k, v in cfg.items()
                                  if k != "__checkpoint__"}
                     results.append(TrialResult(trial_id, cfg_clean, latest,
@@ -243,9 +275,9 @@ class Tuner:
                     # with the perturbed config
                     new_cfg = dict(exploit["config"])
                     new_cfg["__checkpoint__"] = exploit["checkpoint"]
-                    ray_tpu.get(hub.reset_iters.remote(trial_id), timeout=60)
                     pending.append((trial_id, new_cfg))
                     continue
+                ray_tpu.get(hub.finish_trial.remote(trial_id), timeout=60)
                 final = dict(latest)
                 final.update(out.get("metrics") or {})
                 cfg_clean = {k: v for k, v in cfg.items()
