@@ -226,18 +226,19 @@ class _LeasePool:
                     core._complete_error(record, err)
         return True
 
-    async def _do_request(self) -> dict:
+    async def _do_request(self) -> Optional[dict]:
         """Acquire one lease. Busy nodes are waited out for as long as the
         shape stays feasible-by-totals (the reference queues leases at the
         raylet, cluster_lease_manager.cc — a saturated cluster must queue,
         not error); only a shape no node can EVER satisfy (PickNode exhausts
-        infeasible_task_timeout_s) or a cluster-wide unreachability raises."""
+        infeasible_task_timeout_s) or a cluster-wide unreachability raises.
+
+        Two-level fast path (reference: lease_policy.cc + raylet
+        spillback): plain leases go straight to the LOCAL raylet, which
+        grants or redirects via its synced resource view — no GCS round
+        trip. PG- and strategy-pinned leases, and the infeasible fallback
+        (which records autoscaler demand), resolve through GCS PickNode."""
         opts, resources = self.opts, self.resources
-        node = await self.core._pick_node(opts, resources)
-        if node is None:
-            raise RuntimeError(f"no feasible node for resources={resources} "
-                               f"selector={opts.label_selector}")
-        raylet = self.core._raylet_client(node["address"])
         req = {
             "resources": resources,
             "label_selector": opts.label_selector,
@@ -246,6 +247,18 @@ class _LeasePool:
             "bundle_index": opts.placement_group_bundle_index,
             "runtime_env": opts.runtime_env,
         }
+        if opts.placement_group is None and opts.scheduling_strategy is None:
+            out = await self._request_two_level(req)
+            if out != "fallback":
+                return out  # a lease, or None (queue drained: stand down)
+            # cluster-wide infeasible / local raylet gone: fall through to
+            # the GCS path, which records demand (autoscaler) and waits
+            # out the infeasible window
+        node = await self.core._pick_node(opts, resources)
+        if node is None:
+            raise RuntimeError(f"no feasible node for resources={resources} "
+                               f"selector={opts.label_selector}")
+        raylet = self.core._raylet_client(node["address"])
         unreachable_deadline = None
         infeasible_since = None
         busy_delay = 0.1
@@ -292,7 +305,7 @@ class _LeasePool:
                         f"raylet reports resources={resources} infeasible")
             else:
                 infeasible_since = None
-            if reply["status"] in ("busy", "infeasible"):
+            if reply["status"] in ("busy", "infeasible", "infeasible_cluster"):
                 # re-pick; a transient None (PG/affinity nodes briefly
                 # absent from the GCS view) keeps the current raylet —
                 # persistent disagreement is bounded by infeasible_since.
@@ -307,6 +320,65 @@ class _LeasePool:
                 busy_delay = min(busy_delay * 1.5, 2.0)
             else:
                 busy_delay = 0.1
+
+    async def _request_two_level(self, base_req: dict):
+        """Lease via the local raylet + spillback chain (reference:
+        normal_task_submitter going to the lease policy's raylet, raylet
+        spillback at cluster_lease_manager.cc:421). Returns a lease dict,
+        None when the queue drained (stand down), or "fallback" when the
+        cluster has no feasible node / the local raylet is unreachable —
+        the caller then uses the GCS path, which records autoscaler demand."""
+        core = self.core
+        addr = core.raylet_address
+        req = dict(base_req, allow_spillback=True)
+        max_hops = RAY_CONFIG.lease_spillback_max_hops
+        hops = 0
+        unreachable = 0
+        busy_delay = 0.1
+        while True:
+            if not self.pending:
+                return None
+            try:
+                reply = pickle.loads(await core._raylet_client(addr).call(
+                    "RequestWorkerLease", pickle.dumps(req),
+                    timeout=RAY_CONFIG.worker_start_timeout_s + 30,
+                    connect_timeout=5.0, retries=1))
+            except (RpcError, asyncio.TimeoutError, OSError):
+                unreachable += 1
+                if addr != core.raylet_address:
+                    # the spill target died mid-chain: restart locally
+                    addr = core.raylet_address
+                    hops = 0
+                    continue
+                if unreachable >= 6:
+                    return "fallback"  # local raylet gone: let GCS decide
+                await asyncio.sleep(0.5)
+                continue
+            unreachable = 0
+            status = reply["status"]
+            if status == "granted":
+                return {"key": self.key, "lease_id": reply["lease_id"],
+                        "worker_address": reply["worker_address"],
+                        "raylet_address": addr,
+                        "last_used": time.monotonic()}
+            if status == "spillback":
+                hops += 1
+                addr = reply["retry_at"]
+                if hops >= max_hops:
+                    # stop chasing: park at the hop-limit raylet (its local
+                    # queue serves us when capacity frees)
+                    req["allow_spillback"] = False
+                continue
+            if status == "busy":
+                # parked a full window without a grant: views may have
+                # changed — re-enable spillback and keep queueing
+                hops = 0
+                req["allow_spillback"] = True
+                await asyncio.sleep(busy_delay)
+                busy_delay = min(busy_delay * 1.5, 2.0)
+                continue
+            # "infeasible" / "infeasible_cluster" / unknown
+            return "fallback"
 
 
 class CoreWorker:
@@ -377,6 +449,13 @@ class CoreWorker:
         self._actor_async = False
         self._exec_pool = None
         self._exec_lock = threading.Lock()
+        # submit-side kickoff batching: one loop wakeup per BURST of
+        # .remote() calls, not one per call (call_soon_threadsafe writes
+        # the loop's self-pipe — ~50us each on a small host)
+        from collections import deque as _deque
+
+        self._kickoff_q: "Any" = _deque()
+        self._kickoff_scheduled = False
         self._order_buf: Dict[str, dict] = {}
         self._tls = threading.local()
         self._shutdown = False
@@ -385,6 +464,27 @@ class CoreWorker:
     # ------------------------------------------------------------------
     # loop plumbing
     # ------------------------------------------------------------------
+
+    def _queue_kickoff(self, fn):
+        """Enqueue a submit-side continuation; wakes the loop only when the
+        queue was idle (benign double-schedule race: drains are no-ops on
+        an empty queue)."""
+        self._kickoff_q.append(fn)
+        if not self._kickoff_scheduled:
+            self._kickoff_scheduled = True
+            self.loop.call_soon_threadsafe(self._drain_kickoffs)
+
+    def _drain_kickoffs(self):
+        self._kickoff_scheduled = False
+        while True:
+            try:
+                fn = self._kickoff_q.popleft()
+            except IndexError:
+                return
+            try:
+                fn()
+            except Exception:
+                logger.exception("task kickoff failed")
 
     def _start_loop(self):
         if self._loop_thread is not None or not self._owned_loop:
@@ -1231,22 +1331,26 @@ class CoreWorker:
             self._register_lineage(task_id, record)
             asyncio.ensure_future(self._drive_task_prepared(remote_fn, record))
 
-        self.loop.call_soon_threadsafe(_kickoff)
+        self._queue_kickoff(_kickoff)
         return refs[0] if opts.num_returns == 1 else refs
 
     async def _drive_task_prepared(self, remote_fn, record: dict):
         """Resolve the (cached) function key + runtime env, then drive."""
         spec: TaskSpec = record["spec"]
         try:
-            spec.options.runtime_env = await self._prepare_runtime_env(
-                spec.options.runtime_env)
+            if spec.options.runtime_env or self.job_runtime_env:
+                spec.options.runtime_env = await self._prepare_runtime_env(
+                    spec.options.runtime_env)
             spec.function_key = await self._push_function(remote_fn.function)
         except Exception as e:
             self._complete_error(record, TaskError(
                 f"submission failed for {record['name']}: {e}",
                 traceback.format_exc()))
             return
-        await self._drive_task(record)
+        # fire-and-forget: completion flows through the result futures; only
+        # recovery re-execution needs to await the record (saves a coroutine
+        # suspension+wake per task on the submit hot path)
+        await self._drive_task(record, wait=False)
 
     def _pack_args(self, args, kwargs):
         # inline small owned values so the executor need not call back
@@ -1279,17 +1383,19 @@ class CoreWorker:
             if fut is not None and not fut.done():
                 await asyncio.shield(fut)
 
-    async def _drive_task(self, record: dict):
+    async def _drive_task(self, record: dict, wait: bool = True):
         """Queue onto the scheduling-key pool (lease reuse + batched pushes;
-        reference: normal_task_submitter.cc + task_manager.cc) and wait for
-        completion. Retries on worker failure happen inside the pool."""
+        reference: normal_task_submitter.cc + task_manager.cc). Retries on
+        worker failure happen inside the pool; ``wait`` is only needed by
+        recovery re-execution (normal completion flows through futures)."""
         spec: TaskSpec = record["spec"]
         opts: TaskOptions = spec.options
         await self._resolve_dependencies(record)
         pool = self._lease_pool_for(opts, opts.required_resources())
         record["_done"] = asyncio.Event()
         pool.submit(record)
-        await record["_done"].wait()
+        if wait:
+            await record["_done"].wait()
 
     def _complete_ok(self, record, results):
         for oid, (kind, payload) in zip(record["return_ids"], results):
@@ -1493,7 +1599,7 @@ class CoreWorker:
             view = self._actor_view(handle.actor_id)
             asyncio.ensure_future(self._drive_actor_task(view, record))
 
-        self.loop.call_soon_threadsafe(_kickoff)
+        self._queue_kickoff(_kickoff)
         return refs[0] if num_returns == 1 else refs
 
     async def _drive_actor_task(self, view: _ActorView, record: dict):
@@ -1608,8 +1714,21 @@ class CoreWorker:
         if method == "PushTaskBatch":
             req = pickle.loads(payload)
             results = []
+            run: List[TaskSpec] = []  # consecutive plain tasks, fused
+
+            async def _flush_run():
+                if run:
+                    results.extend(await self._exec_normal_batch(run))
+                    run.clear()
+
             for spec in req["specs"]:
-                results.append(pickle.loads(await self._handle_push_task(spec)))
+                if spec.actor_id is None and not spec.is_actor_creation:
+                    run.append(spec)
+                else:
+                    await _flush_run()
+                    results.append(
+                        pickle.loads(await self._handle_push_task(spec)))
+            await _flush_run()
             return pickle.dumps({"results": results})
         if method == "GetOwnedObject":
             return await self._handle_get_owned(pickle.loads(payload))
@@ -1744,10 +1863,66 @@ class CoreWorker:
             self._exec_pool, self._call_user_fn, fn, args, kwargs, spec)
         self._trace_task(spec, getattr(fn, "__name__", "task"), t0, err)
         del args, kwargs  # drop our handles before computing borrows
-        return await self._pack_results(
-            spec, result, err, borrows=self._surviving_borrows(seen_refs))
+        return pickle.dumps(await self._pack_results(
+            spec, result, err, borrows=self._surviving_borrows(seen_refs)))
 
-    def _trace_task(self, spec: TaskSpec, name: str, t0: float, err):
+    async def _exec_normal_batch(self, specs: List[TaskSpec]) -> List[dict]:
+        """Execute a run of plain tasks with ONE thread-pool hop. The
+        per-task run_in_executor queue/GIL handoff costs ~0.5 ms on a
+        small host — dominating trivial tasks — and the batch executes
+        sequentially on the pool thread anyway (reference: leased workers
+        run tasks serially, task_receiver.cc)."""
+        if self.job_id.is_nil():
+            self.job_id = specs[0].job_id
+        prepared: List[tuple] = []  # (spec, fn, args, kwargs, seen) | (spec, TaskError)
+        for spec in specs:
+            try:
+                fn = await self._fetch_function(spec.function_key)
+                args, kwargs, seen = await self._resolve_args(spec.args_blob)
+                prepared.append((spec, fn, args, kwargs, seen))
+            except TaskError as e:
+                # a PRODUCER's application error: deterministic, propagate
+                # to this dependent as its own app error (no retry value)
+                prepared.append((spec, e))
+            # transient infra errors (object lost, owner unreachable, ...)
+            # propagate and fail the whole RPC — the owner retries against
+            # max_retries exactly like the unbatched path; nothing has
+            # executed yet (prepare runs before _run_all), so no task
+            # re-executes because of a batch-mate's infrastructure failure
+        self._ensure_pool(1)
+
+        def _run_all():
+            out = []
+            for i, entry in enumerate(prepared):
+                if len(entry) == 2:
+                    out.append(None)
+                    continue
+                spec, fn, args, kwargs, _seen = entry
+                t0 = time.time()
+                result, err = self._call_user_fn(fn, args, kwargs, spec)
+                out.append((result, err, t0, time.time()))
+                # drop the arg handles as each task finishes so its
+                # surviving-borrow report below sees only real survivors
+                prepared[i] = (spec, fn, None, None, _seen)
+            return out
+
+        outcomes = await self.loop.run_in_executor(self._exec_pool, _run_all)
+        replies = []
+        for entry, outcome in zip(prepared, outcomes):
+            if outcome is None:
+                replies.append({"status": "app_error",
+                                "error": pickle.dumps(entry[1])})
+                continue
+            spec, fn, _a, _k, seen = entry
+            result, err, t0, t1 = outcome
+            self._trace_task(spec, getattr(fn, "__name__", "task"), t0, err,
+                             t1=t1)
+            replies.append(await self._pack_results(
+                spec, result, err, borrows=self._surviving_borrows(seen)))
+        return replies
+
+    def _trace_task(self, spec: TaskSpec, name: str, t0: float, err,
+                    t1: Optional[float] = None):
         """Span per executed task (reference: profile_event.cc into the
         task event buffer); no-op unless tracing is enabled."""
         from ray_tpu.util import tracing
@@ -1757,7 +1932,7 @@ class CoreWorker:
         if spec.actor_id is not None and spec.method_name:
             name = f"{type(self.actor_instance).__name__}.{spec.method_name}"                 if self.actor_instance is not None else spec.method_name
         tracing.record_span(
-            name, t0, time.time(),
+            name, t0, t1 if t1 is not None else time.time(),
             category="actor_task" if spec.actor_id is not None else "task",
             task_id=spec.task_id.hex(), ok=err is None)
 
@@ -1809,9 +1984,11 @@ class CoreWorker:
         return out
 
     async def _pack_results(self, spec: TaskSpec, result, err,
-                            transport: str = "", borrows=()) -> bytes:
+                            transport: str = "", borrows=()) -> dict:
+        """Build one task's reply dict (callers pickle it, or embed it
+        directly in a batch reply — no per-task double pickling)."""
         if err is not None:
-            return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
+            return {"status": "app_error", "error": pickle.dumps(err)}
         values: List[Any]
         if spec.num_returns == 0:
             values = []
@@ -1823,7 +2000,7 @@ class CoreWorker:
                 err = TaskError(
                     f"task declared num_returns={spec.num_returns} but returned "
                     f"{len(values)} values", "")
-                return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
+                return {"status": "app_error", "error": pickle.dumps(err)}
         from ray_tpu.object_ref import collect_serialized_refs
 
         results = []
@@ -1850,8 +2027,8 @@ class CoreWorker:
                     # stored blobs hold refs only as bytes: the owner must
                     # pin them for the blob's lifetime
                     nested[oid.binary()] = inner
-        return pickle.dumps({"status": "ok", "results": results,
-                             "borrows": list(borrows), "nested": nested})
+        return {"status": "ok", "results": results,
+                "borrows": list(borrows), "nested": nested}
 
     async def _exec_actor_creation(self, spec: TaskSpec) -> bytes:
         if self.job_id.is_nil():
@@ -1941,9 +2118,9 @@ class CoreWorker:
                 self._exec_pool, self._call_user_fn, method, args, kwargs, spec)
         self._trace_task(spec, spec.method_name, t0, err)
         del args, kwargs  # drop our handles before computing borrows
-        return await self._pack_results(
+        return pickle.dumps(await self._pack_results(
             spec, result, err, transport=transport,
-            borrows=self._surviving_borrows(seen_refs))
+            borrows=self._surviving_borrows(seen_refs)))
 
     # ------------------------------------------------------------------
     # shutdown

@@ -134,6 +134,9 @@ class GcsServer:
         self.server.on_disconnect = self._on_disconnect
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.node_available: Dict[NodeID, Dict[str, float]] = {}
+        # last availability broadcast per node (delta suppression for the
+        # resource_view syncer stream; reference: ray_syncer.h:89)
+        self._last_view_pub: Dict[NodeID, Dict[str, float]] = {}
         self.node_last_seen: Dict[NodeID, float] = {}
         self.node_clients: Dict[NodeID, RetryingRpcClient] = {}
         self.kv: Dict[Tuple[str, str], bytes] = {}
@@ -297,6 +300,7 @@ class GcsServer:
         logger.info("node %s registered: %s labels=%s", info.node_id.hex()[:8],
                     info.total_resources, info.labels)
         self._publish("nodes", {"event": "added", "node": info.to_dict()})
+        self._publish("resource_view", self._view_entry(info.node_id))
         return {"status": "ok"}
 
     async def _rpc_Heartbeat(self, req, conn):
@@ -308,10 +312,36 @@ class GcsServer:
         self.node_num_leases[node_id] = req.get("num_leases", 0)
         if self._node_used(node_id) or node_id not in self.node_last_used:
             self.node_last_used[node_id] = time.monotonic()
+        # syncer: broadcast availability DELTAS to subscribed raylets so
+        # their local schedulers can spill leases peer-to-peer without a
+        # per-lease GCS round trip (reference: ray_syncer.h:89 resource
+        # views over bidi streams; here piggybacked on 1 Hz heartbeats)
+        if self._last_view_pub.get(node_id) != req["available"]:
+            self._last_view_pub[node_id] = dict(req["available"])
+            self._publish("resource_view", self._view_entry(node_id))
+        # parked lease shapes feed the autoscaler's demand view (the
+        # two-level path no longer touches PickNode for schedulable work)
+        for shape in req.get("pending_shapes", ()):
+            self._record_demand(shape["resources"], shape.get("selector", {}),
+                                shape.get("waiter_id", ""))
         return {"status": "ok"}
 
+    def _view_entry(self, node_id: NodeID) -> dict:
+        info = self.nodes[node_id]
+        return {
+            "node_id": node_id.hex(),
+            "address": info.address,
+            "available": dict(self.node_available.get(node_id, {})),
+            "total": dict(info.total_resources),
+            "labels": dict(info.labels),
+            "alive": info.alive,
+        }
+
     async def _rpc_GetAllNodes(self, req, conn):
-        return {"nodes": [n.to_dict() for n in self.nodes.values()]}
+        return {"nodes": [
+            {**n.to_dict(),
+             "available": dict(self.node_available.get(n.node_id, {}))}
+            for n in self.nodes.values()]}
 
     async def _rpc_GetClusterResources(self, req, conn):
         total: Dict[str, float] = {}
@@ -349,6 +379,7 @@ class GcsServer:
         self._persist_node(info)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish("nodes", {"event": "removed", "node_id": node_id.hex(), "reason": reason})
+        self._publish("resource_view", self._view_entry(node_id))
         # drop object locations on that node; keep the committed-attempt
         # tombstone so a partitioned zombie's stale announce can't
         # re-register an older epoch as current

@@ -53,6 +53,15 @@ def main(duration: float = 2.0) -> List[Dict]:
 
     results.append(timeit("tasks_async_batch_per_s", batch_tasks, duration))
 
+    # deep pipeline, the reference's async-task shape (ray_perf.py keeps
+    # ~1000 tasks in flight): amortizes the submit/complete barrier
+    def pipeline_tasks():
+        refs = [_noop.remote() for _ in range(1000)]
+        ray_tpu.get(refs, timeout=120)
+        return 1000
+
+    results.append(timeit("tasks_pipeline1k_per_s", pipeline_tasks, duration))
+
     # actor calls 1:1 sync + async batches (ray_perf.py:198-243)
     actor = _BenchActor.remote()
     ray_tpu.get(actor.noop.remote(), timeout=60)

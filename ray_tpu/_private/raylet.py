@@ -8,10 +8,14 @@ detection, and the node object plane: it hosts the shared-memory object store
 (plasma ``store_runner.cc``) and the pull/push transfer manager
 (``object_manager/pull_manager.cc``).
 
-Deviation from the reference: node selection for a lease happens owner-side
-via the GCS resource view (``PickNode``) rather than raylet spillback chains;
-the raylet still queues lease grants locally when resources are busy, so the
-two-level scheduler shape (cluster pick + local grant) is preserved.
+Two-level scheduling (reference: cluster_lease_manager.cc:196 grant-or-
+spillback at :421): plain lease requests go to the OWNER'S LOCAL raylet,
+which grants from its pool or replies ``spillback`` with a peer chosen from
+its synced cluster resource view — no per-lease GCS round trip. The view is
+maintained by subscribing to the GCS ``resource_view`` delta stream
+(reference: ray_syncer.h:89); placement-group and strategy-pinned leases
+still resolve through the GCS (`PickNode`), as does the infeasible fallback
+that feeds autoscaler demand.
 """
 
 from __future__ import annotations
@@ -27,7 +31,13 @@ import time
 import uuid
 from typing import Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private.common import NodeInfo, resources_add, resources_ge, resources_sub
+from ray_tpu._private.common import (
+    NodeInfo,
+    label_match,
+    resources_add,
+    resources_ge,
+    resources_sub,
+)
 from ray_tpu._private.config import RAY_CONFIG
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.object_store import ObjectStoreServer
@@ -79,7 +89,14 @@ class Raylet:
         self.is_head = is_head
         self.log_dir = log_dir
         self.server = RpcServer(self._handle, host, port)
-        self.gcs = RetryingRpcClient(gcs_address)
+        self.gcs = RetryingRpcClient(gcs_address, on_push=self._on_gcs_push,
+                                     on_reconnect=self._on_gcs_reconnect)
+        # synced view of peer nodes (node_hex -> {address, available, total,
+        # labels, alive}) fed by the GCS resource_view delta stream
+        self.cluster_view: Dict[str, dict] = {}
+        # parked lease shapes (req_id -> {resources, selector}) reported on
+        # heartbeats as autoscaler demand
+        self._parked: Dict[str, dict] = {}
         self.total_resources = dict(resources or {})
         self.available = dict(self.total_resources)
         self.labels = dict(labels or {})
@@ -118,6 +135,7 @@ class Raylet:
             is_head=self.is_head,
         )
         await self.gcs.call("RegisterNode", pickle.dumps({"info": info}))
+        await self._subscribe_view()
         self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._background.append(asyncio.ensure_future(self._monitor_workers_loop()))
         self._background.append(asyncio.ensure_future(self._prestart_workers()))
@@ -151,6 +169,68 @@ class Raylet:
         self.store.shutdown()
         await self.server.stop()
 
+    async def _subscribe_view(self, client=None):
+        """Subscribe to the resource_view delta stream and seed the local
+        cluster view (reference: ray_syncer snapshot + deltas). Re-run on
+        every reconnect: deltas published during a disconnect are lost, and
+        a node that died in that window never heartbeats again, so only a
+        fresh snapshot can correct the view."""
+        client = client or self.gcs
+        await client.call("Subscribe", pickle.dumps(
+            {"channels": ["resource_view"]}))
+        reply = pickle.loads(await client.call("GetAllNodes", b""))
+        for n in reply["nodes"]:
+            self.cluster_view[n["node_id"]] = {
+                "address": n["address"],
+                "available": n.get("available", {}),
+                "total": n["total_resources"],
+                "labels": n.get("labels", {}),
+                "alive": n.get("alive", True),
+            }
+
+    def _on_gcs_push(self, channel: str, payload: bytes):
+        if channel != "resource_view":
+            return
+        msg = pickle.loads(payload)
+        self.cluster_view[msg["node_id"]] = {
+            "address": msg["address"], "available": msg["available"],
+            "total": msg["total"], "labels": msg["labels"],
+            "alive": msg["alive"],
+        }
+
+    async def _on_gcs_reconnect(self, client):
+        try:
+            await self._subscribe_view(client)
+        except Exception:
+            logger.warning("resource_view re-subscribe failed", exc_info=True)
+
+    def _pick_spill_node(self, resources, selector,
+                         require_available: bool = True) -> Optional[str]:
+        """Choose a peer raylet for spillback from the synced view (hybrid
+        policy: pack onto the most-utilized feasible peer below the spread
+        threshold, else the least utilized; reference:
+        policy/hybrid_scheduling_policy.cc)."""
+        me = self.node_id.hex()
+        candidates = []
+        for hex_id, v in self.cluster_view.items():
+            if hex_id == me or not v["alive"]:
+                continue
+            if selector and not label_match(v.get("labels", {}), selector):
+                continue
+            pool = v["available"] if require_available else v["total"]
+            if not resources_ge(pool, resources):
+                continue
+            fracs = [1.0 - v["available"].get(k, 0.0) / t
+                     for k, t in v["total"].items() if t > 0]
+            candidates.append((max(fracs) if fracs else 0.0, hex_id,
+                               v["address"]))
+        if not candidates:
+            return None
+        candidates.sort()
+        threshold = RAY_CONFIG.scheduler_spread_threshold
+        packed = [c for c in candidates if c[0] < threshold]
+        return (packed[-1] if packed else candidates[0])[2]
+
     async def _heartbeat_loop(self):
         period = RAY_CONFIG.health_check_period_ms / 1000.0
         while True:
@@ -161,6 +241,12 @@ class Raylet:
                     # lease count keeps zero-resource actors visible to the
                     # autoscaler's idle detection
                     "num_leases": len(self.leases),
+                    # parked lease shapes = autoscaler demand
+                    "pending_shapes": [
+                        {"resources": p["resources"],
+                         "selector": p.get("selector", {}),
+                         "waiter_id": rid}
+                        for rid, p in list(self._parked.items())],
                 }), timeout=5.0, retries=0))
                 if reply.get("status") == "unknown_node":
                     info = NodeInfo(
@@ -345,40 +431,73 @@ class Raylet:
         resources = req["resources"]
         pg = req.get("pg")
         bundle_index = req.get("bundle_index", -1)
+        selector = req.get("label_selector") or {}
+        allow_spill = bool(req.get("allow_spillback"))
         renv = req.get("runtime_env")
         renv_hash = env_hash(renv)
         job_hex = req["job_id"].hex() if req.get("job_id") is not None else None
         deadline = time.monotonic() + RAY_CONFIG.worker_start_timeout_s
-        while True:
-            pool = self._lease_pool(pg, bundle_index)
-            if resources_ge(pool, resources):
-                resources_sub(pool, resources)
+        # the two-level path sends plain leases here directly: this raylet
+        # must check the label selector itself (the legacy GCS PickNode
+        # path pre-filters, so selector-carrying requests it routed are
+        # always satisfied and the check is a no-op for them)
+        local_ok = pg is not None or (
+            label_match(self.labels, selector)
+            and resources_ge(self.total_resources, resources))
+        if not local_ok:
+            if allow_spill:
+                alt = self._pick_spill_node(resources, selector,
+                                            require_available=False)
+                if alt:
+                    return {"status": "spillback", "retry_at": alt}
+            if pg is None and label_match(self.labels, selector):
+                return {"status": "infeasible",
+                        "total": dict(self.total_resources)}
+            return {"status": "infeasible_cluster"}
+        parked_id = None
+        try:
+            while True:
+                pool = self._lease_pool(pg, bundle_index)
+                if resources_ge(pool, resources):
+                    resources_sub(pool, resources)
+                    try:
+                        w = await self._pop_worker(job_hex, renv, renv_hash)
+                    except (asyncio.TimeoutError, Exception):
+                        resources_add(pool, resources)
+                        raise
+                    lease_id = uuid.uuid4().hex
+                    w.leases.add(lease_id)
+                    # remember which pool to credit on release
+                    self.leases[lease_id] = (w, resources, pickle.dumps((pg, bundle_index)))
+                    return {
+                        "status": "granted",
+                        "lease_id": lease_id,
+                        "worker_address": w.address,
+                        "worker_pid": w.pid,
+                        "node_id": self.node_id.hex(),
+                    }
+                if allow_spill:
+                    # busy here but a peer has capacity NOW: spill back
+                    # (reference: cluster_lease_manager.cc:421)
+                    alt = self._pick_spill_node(resources, selector,
+                                                require_available=True)
+                    if alt:
+                        return {"status": "spillback", "retry_at": alt}
+                if time.monotonic() > deadline:
+                    return {"status": "busy"}
+                if parked_id is None:
+                    parked_id = uuid.uuid4().hex
+                    self._parked[parked_id] = {"resources": dict(resources),
+                                               "selector": dict(selector)}
+                fut = asyncio.get_event_loop().create_future()
+                self._lease_waiters.append(fut)
                 try:
-                    w = await self._pop_worker(job_hex, renv, renv_hash)
-                except (asyncio.TimeoutError, Exception):
-                    resources_add(pool, resources)
-                    raise
-                lease_id = uuid.uuid4().hex
-                w.leases.add(lease_id)
-                # remember which pool to credit on release
-                self.leases[lease_id] = (w, resources, pickle.dumps((pg, bundle_index)))
-                return {
-                    "status": "granted",
-                    "lease_id": lease_id,
-                    "worker_address": w.address,
-                    "worker_pid": w.pid,
-                    "node_id": self.node_id.hex(),
-                }
-            if not resources_ge(self.total_resources, resources) and pg is None:
-                return {"status": "infeasible", "total": dict(self.total_resources)}
-            if time.monotonic() > deadline:
-                return {"status": "busy"}
-            fut = asyncio.get_event_loop().create_future()
-            self._lease_waiters.append(fut)
-            try:
-                await asyncio.wait_for(fut, timeout=1.0)
-            except asyncio.TimeoutError:
-                pass
+                    await asyncio.wait_for(fut, timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            if parked_id is not None:
+                self._parked.pop(parked_id, None)
 
     def _release_lease(self, lease_id: str):
         entry = self.leases.pop(lease_id, None)
@@ -422,6 +541,8 @@ class Raylet:
             "num_leases": len(self.leases),
             "store": self.store.stats(),
             "labels": dict(self.labels),
+            "cluster_view_size": sum(
+                1 for v in self.cluster_view.values() if v["alive"]),
         }
 
     # ------------------------------------------------------------------

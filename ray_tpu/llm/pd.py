@@ -205,7 +205,13 @@ class DPRankAssigner:
 
 class DPLLMServer:
     """LLMServer variant that claims a dp rank at start (reference:
-    dp_server.py — rank coordination around SPMD engine replicas)."""
+    dp_server.py — rank coordination around SPMD engine replicas).
+
+    Rank leases are time-based, not fenced: a replica that stalls past the
+    lease TTL can briefly coexist with its replacement on the same rank
+    until its next renew tick observes the eviction and re-assigns. Ranks
+    here tag responses and drive engine sharding identity at START; they
+    are not a mutual-exclusion token mid-request."""
 
     def __init__(self, config: LLMConfig, params_blob: Optional[bytes] = None,
                  assigner_name: str = ""):
@@ -214,6 +220,8 @@ class DPLLMServer:
         self._inner = LLMServer(config, params_blob)
         self.replica_id = uuid.uuid4().hex
         self.dp_rank = -1
+        self._stopped = False
+        self._assigner_name = assigner_name
         if assigner_name:
             assigner = ray_tpu.get_actor(assigner_name)
             self.dp_rank = ray_tpu.get(
@@ -248,6 +256,19 @@ class DPLLMServer:
 
     def rank(self) -> int:
         return self.dp_rank
+
+    def shutdown(self):
+        """Stop the lease renew loop and release the rank promptly (a
+        killed replica's lease otherwise only frees after the TTL)."""
+        self._stopped = True
+        try:
+            assigner = ray_tpu.get_actor(self._assigner_name)
+            assigner.release.remote(self.replica_id)
+        except Exception:
+            pass  # TTL eviction reclaims the slot eventually
+
+    def __del__(self):
+        self._stopped = True
 
 
 def build_dp_openai_app(config: LLMConfig, dp_size: int, params: Any = None

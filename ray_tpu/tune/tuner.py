@@ -135,14 +135,17 @@ class _ReportHub:
             if sync_t and t % sync_t == 0:
                 # synchronized PBT: wait until every live trial reached this
                 # boundary (or finished) so the decision sees the whole
-                # population; bounded so a crashed trial can't wedge us
+                # population. Bounded: a crashed trial, or one whose worker
+                # cannot schedule (num_samples > max_concurrent_trials on a
+                # saturated cluster), degrades to a partial-population
+                # decision after the timeout instead of wedging the run.
                 def _ready():
                     return all(self.iters.get(tid, 0) >= t
                                or tid in self.finished
                                for tid in self.registered)
 
                 self._cv.notify_all()
-                self._cv.wait_for(_ready, timeout=60.0)
+                self._cv.wait_for(_ready, timeout=30.0)
             return self.scheduler.on_result(trial_id, metrics)
 
     # NOTE: exploited trials do NOT reset their iteration counter — the

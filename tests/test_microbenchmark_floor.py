@@ -1,0 +1,40 @@
+"""Core-throughput floors: catch order-of-magnitude regressions in the
+submit/execute/object paths (reference: release/microbenchmark tracking of
+ray_perf.py numbers). Floors sit far below measured best-of (see
+MICROBENCH_r04.json) because CI hosts are noisy single-core VMs — this
+guards against wedged batching/scheduling, not run-to-run variance.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import microbenchmark
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+FLOORS = {
+    "tasks_async_batch_per_s": 500.0,
+    "tasks_pipeline1k_per_s": 1200.0,
+    "actor_calls_async_batch_per_s": 1500.0,
+    "put_small_per_s": 2500.0,
+}
+
+
+def test_core_throughput_floors(cluster):
+    results = {r["name"]: r for r in microbenchmark.main(duration=1.5)}
+    failures = []
+    for name, floor in FLOORS.items():
+        rate = results[name]["rate_per_s"]
+        if rate < floor:
+            failures.append(f"{name}: {rate:.0f}/s < floor {floor:.0f}/s")
+    assert not failures, "; ".join(failures)
+    # object plane bandwidth (10MB roundtrips)
+    gbs = results["put_get_10MB_roundtrips_per_s"]["GB_per_s"]
+    assert gbs >= 0.8, f"object plane bandwidth {gbs} GB/s below floor"
