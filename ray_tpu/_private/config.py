@@ -92,6 +92,12 @@ _d("worker_start_timeout_s", 60.0)
 # before failing with a scheduling error
 _d("infeasible_task_timeout_s", 300.0)
 
+# --- OOM defense (reference: memory_monitor.h:52) ---
+_d("memory_usage_threshold", 0.95)
+_d("memory_monitor_refresh_ms", 500)
+# 0 = node-level /proc/meminfo accounting; >0 = budget over worker RSS
+_d("memory_monitor_capacity_bytes", 0)
+
 # --- object store ---
 _d("object_store_memory", 2 * 1024**3)
 _d("object_inline_max_bytes", 100 * 1024)

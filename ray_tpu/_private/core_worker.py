@@ -47,6 +47,7 @@ from ray_tpu.exceptions import (
     ActorUnavailableError,
     GetTimeoutError,
     ObjectLostError,
+    OutOfMemoryError,
     TaskError,
 )
 from ray_tpu.object_ref import ObjectRef
@@ -200,16 +201,30 @@ class _LeasePool:
                 lease["worker_address"]).call(
                     "PushTaskBatch", payload, timeout=86400.0, retries=0))
         except (RpcError, asyncio.TimeoutError, OSError) as e:
+            # requeue retriable records FIRST: the OOM probe below can take
+            # seconds against a dead raylet and is only needed when some
+            # record is about to surface a terminal error
+            exhausted = []
             for record in batch:
                 record["attempts"] += 1
                 if record["attempts"] > record["max_retries"]:
-                    core._complete_error(record, TaskError(
-                        f"worker died running {record['name']} "
-                        f"(after {record['attempts']} attempts): {e}", ""))
+                    exhausted.append(record)
                 else:
                     logger.warning("retrying task %s (attempt %d): %s",
                                    record["name"], record["attempts"], e)
                     self.pending.append(record)
+            if exhausted:
+                oom = await self._was_oom(lease)
+                for record in exhausted:
+                    if oom:
+                        core._complete_error(record, OutOfMemoryError(
+                            f"worker running {record['name']} was killed by "
+                            f"the node memory monitor (after "
+                            f"{record['attempts']} attempts)", ""))
+                    else:
+                        core._complete_error(record, TaskError(
+                            f"worker died running {record['name']} "
+                            f"(after {record['attempts']} attempts): {e}", ""))
             return False
         for record, res in zip(batch, reply["results"]):
             if res["status"] == "ok":
@@ -225,6 +240,19 @@ class _LeasePool:
                 else:
                     core._complete_error(record, err)
         return True
+
+    async def _was_oom(self, lease: dict) -> bool:
+        """After a push failure, ask the granting raylet whether the memory
+        monitor killed the worker (surfaces OutOfMemoryError to the user)."""
+        try:
+            reply = pickle.loads(await self.core._raylet_client(
+                lease["raylet_address"]).call(
+                    "WasWorkerOOM", pickle.dumps(
+                        {"worker_address": lease["worker_address"]}),
+                    timeout=5.0, retries=0))
+            return bool(reply.get("oom"))
+        except (RpcError, asyncio.TimeoutError, OSError):
+            return False
 
     async def _do_request(self) -> Optional[dict]:
         """Acquire one lease. Busy nodes are waited out for as long as the

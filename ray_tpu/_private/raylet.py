@@ -68,6 +68,11 @@ class WorkerProc:
         self.renv_hash = renv_hash  # workers are dedicated to one runtime env
         self.leases: Set[str] = set()
         self.idle_since = time.monotonic()
+        self.started = time.monotonic()
+        # refreshed on every lease grant: the OOM victim policy ranks by
+        # work-assignment recency, not process age (reused workers are old
+        # processes that may hold the newest work)
+        self.last_assigned = time.monotonic()
         self.client: Optional[RetryingRpcClient] = None
 
 
@@ -97,6 +102,9 @@ class Raylet:
         # parked lease shapes (req_id -> {resources, selector}) reported on
         # heartbeats as autoscaler demand
         self._parked: Dict[str, dict] = {}
+        # OOM defense: workers killed by the memory monitor, so owners can
+        # surface OutOfMemoryError instead of a generic worker death
+        self.oom_kills: Dict[str, float] = {}  # worker_address -> kill ts
         self.total_resources = dict(resources or {})
         self.available = dict(self.total_resources)
         self.labels = dict(labels or {})
@@ -138,6 +146,7 @@ class Raylet:
         await self._subscribe_view()
         self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._background.append(asyncio.ensure_future(self._monitor_workers_loop()))
+        self._background.append(asyncio.ensure_future(self._memory_monitor_loop()))
         self._background.append(asyncio.ensure_future(self._prestart_workers()))
         self._background.append(asyncio.ensure_future(self._prewarm_store()))
         if self.log_dir:
@@ -230,6 +239,47 @@ class Raylet:
         threshold = RAY_CONFIG.scheduler_spread_threshold
         packed = [c for c in candidates if c[0] < threshold]
         return (packed[-1] if packed else candidates[0])[2]
+
+    async def _memory_monitor_loop(self):
+        """OOM defense (reference: memory_monitor.h:52 + the group-by-owner
+        worker killing policy): while node memory is above the threshold,
+        kill the newest worker of the job with the most workers, record the
+        kill so the owner can surface OutOfMemoryError, and repeat until
+        back under — one worker dies, the node survives."""
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+
+        monitor = MemoryMonitor()
+        period = RAY_CONFIG.memory_monitor_refresh_ms / 1000.0
+        while True:
+            await asyncio.sleep(period)
+            try:
+                pids = [w.pid for w in self.workers.values()]
+                over, why = monitor.over_threshold(pids)
+                if not over:
+                    continue
+                victim = MemoryMonitor.pick_victim([
+                    {"pid": w.pid, "job": w.job_hex,
+                     "started": w.last_assigned, "_w": w}
+                    for w in self.workers.values()])
+                if victim is None:
+                    logger.warning("OOM pressure but no workers to kill: %s",
+                                   why)
+                    continue
+                w = victim["_w"]
+                logger.warning(
+                    "OOM defense: killing worker pid=%d (job=%s, newest of "
+                    "largest owner group) — %s", w.pid, w.job_hex, why)
+                if w.address:
+                    self.oom_kills[w.address] = time.monotonic()
+                    if len(self.oom_kills) > 256:
+                        oldest = min(self.oom_kills, key=self.oom_kills.get)
+                        del self.oom_kills[oldest]
+                try:
+                    w.proc.kill()
+                except Exception:
+                    pass
+            except Exception:
+                logger.exception("memory monitor iteration failed")
 
     async def _heartbeat_loop(self):
         period = RAY_CONFIG.health_check_period_ms / 1000.0
@@ -402,12 +452,19 @@ class Raylet:
                 for lease_id in list(w.leases):
                     self._release_lease(lease_id)
                 if w.address:
-                    logger.warning("worker %s (pid %d) exited with %s", w.address, pid, code)
+                    reason = f"exit code {code}"
+                    if w.address in self.oom_kills:
+                        # attribute memory-monitor kills at the mechanism
+                        # level: actor owners see the OOM cause too
+                        reason = ("OOM-killed by the node memory monitor "
+                                  f"({reason})")
+                    logger.warning("worker %s (pid %d) exited: %s",
+                                   w.address, pid, reason)
                     try:
                         await self.gcs.call("WorkerDied", pickle.dumps({
                             "worker_address": w.address,
                             "node_id": self.node_id.hex(),
-                            "reason": f"exit code {code}",
+                            "reason": reason,
                         }), retries=2)
                     except (RpcError, asyncio.TimeoutError, OSError):
                         pass
@@ -467,6 +524,7 @@ class Raylet:
                         raise
                     lease_id = uuid.uuid4().hex
                     w.leases.add(lease_id)
+                    w.last_assigned = time.monotonic()
                     # remember which pool to credit on release
                     self.leases[lease_id] = (w, resources, pickle.dumps((pg, bundle_index)))
                     return {
@@ -520,6 +578,11 @@ class Raylet:
     async def _rpc_ReturnWorkerLease(self, req, conn):
         self._release_lease(req["lease_id"])
         return {"status": "ok"}
+
+    async def _rpc_WasWorkerOOM(self, req, conn):
+        # owners ask after a push failure whether the memory monitor killed
+        # the worker, to surface OutOfMemoryError instead of a generic death
+        return {"oom": req["worker_address"] in self.oom_kills}
 
     async def _rpc_KillWorker(self, req, conn):
         w = self.workers_by_addr.get(req["worker_address"])

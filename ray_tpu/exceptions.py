@@ -29,6 +29,11 @@ class WorkerCrashedError(RayTpuError):
     """The worker executing the task died unexpectedly (e.g. OOM-killed)."""
 
 
+class OutOfMemoryError(TaskError):
+    """The memory monitor killed the worker running this task (reference:
+    ray.exceptions.OutOfMemoryError; raylet worker_killing_policy)."""
+
+
 class ActorError(RayTpuError):
     pass
 
