@@ -191,10 +191,16 @@ class _LeasePool:
     async def _push_batch(self, lease: dict, batch: List[dict]) -> bool:
         """Ship a batch to the leased worker. Returns False if the lease
         died (records are retried/failed individually)."""
+        from ray_tpu.exceptions import TaskCancelledError
+
         core = self.core
+        batch = [r for r in batch if not self._drop_if_cancelled(r)]
+        if not batch:
+            return True
         for record in batch:
             record["epoch"] = record.get("epoch", -1) + 1
             record["spec"].attempt = record["epoch"]
+            record["_pushed_to"] = lease["worker_address"]
         payload = pickle.dumps({"specs": [r["spec"] for r in batch]})
         try:
             reply = pickle.loads(await core._worker_client(
@@ -206,12 +212,18 @@ class _LeasePool:
             # record is about to surface a terminal error
             exhausted = []
             for record in batch:
+                if record.get("_cancelled"):
+                    # force-cancel kills the worker: deliver the
+                    # cancellation, never a retry
+                    core._complete_error(record, TaskCancelledError())
+                    continue
                 record["attempts"] += 1
                 if record["attempts"] > record["max_retries"]:
                     exhausted.append(record)
                 else:
                     logger.warning("retrying task %s (attempt %d): %s",
                                    record["name"], record["attempts"], e)
+                    self._reset_stream_for_retry(record)
                     self.pending.append(record)
             if exhausted:
                 oom = await self._was_oom(lease)
@@ -229,16 +241,37 @@ class _LeasePool:
         for record, res in zip(batch, reply["results"]):
             if res["status"] == "ok":
                 core._process_reply_refs(res, lease["worker_address"])
-                core._complete_ok(record, res["results"])
+                core._complete_ok(record, res["results"],
+                                  stream_count=res.get("stream_count"))
             else:
                 err: TaskError = pickle.loads(res["error"])
                 opts = record["spec"].options
                 if opts.retry_exceptions \
+                        and not isinstance(err, TaskCancelledError) \
                         and record["attempts"] < record["max_retries"]:
                     record["attempts"] += 1
+                    self._reset_stream_for_retry(record)
                     self.pending.append(record)
                 else:
                     core._complete_error(record, err)
+        return True
+
+    def _reset_stream_for_retry(self, record: dict):
+        """A retried streaming task replays from index 0 under a new
+        attempt: unconsumed indices must wait for the retry's values
+        instead of serving a dead attempt's partial output."""
+        if record["spec"].num_returns != -1:
+            return
+        st = self.core._streams.get(record["spec"].task_id.binary())
+        if st is not None:
+            st["produced"] = 0
+
+    def _drop_if_cancelled(self, record: dict) -> bool:
+        if not record.get("_cancelled"):
+            return False
+        from ray_tpu.exceptions import TaskCancelledError
+
+        self.core._complete_error(record, TaskCancelledError())
         return True
 
     async def _was_oom(self, lease: dict) -> bool:
@@ -468,6 +501,13 @@ class CoreWorker:
         self.segments = SegmentCache()
         # executor state
         self._fn_cache: Dict[str, Any] = {}
+        # cancellation: running task_id -> executing thread ident, plus
+        # cancels that arrived before their task started
+        self._running_tasks: Dict[bytes, int] = {}
+        self._cancelled_pending: set = set()
+        # streaming generators: task_id -> {produced, total, error, event}
+        # (reference: task_manager.cc dynamic return handling)
+        self._streams: Dict[bytes, dict] = {}
         self.actor_instance = None
         self.actor_id: Optional[ActorID] = None
         # device-object transport (reference: per-actor GPUObjectStore):
@@ -1243,6 +1283,7 @@ class CoreWorker:
 
     def _drop_record(self, task_id: TaskID, rec: dict):
         self._tasks.pop(task_id, None)
+        self.stream_release(task_id)
         self._lineage_bytes -= rec.get("bytes", 0)
         rc = self.ref_counter
         for rid in rec.get("return_ids", ()):
@@ -1331,15 +1372,17 @@ class CoreWorker:
         trip per call (reference: the owner-side submit path is the tasks/s
         hot loop, normal_task_submitter.cc)."""
         task_id = TaskID.of(self.job_id)
+        streaming = opts.num_returns == "streaming"
+        nret = 0 if streaming else opts.num_returns
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
-                for i in range(opts.num_returns)]
+                for i in range(nret)]
         args_blob, arg_refs = self._pack_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
             function_key="",  # filled by _drive_task_prepared
             args_blob=args_blob,
-            num_returns=opts.num_returns,
+            num_returns=-1 if streaming else nret,
             options=opts,
             owner_address=self.address,
         )
@@ -1354,13 +1397,22 @@ class CoreWorker:
         for ref in refs:
             # created off-loop so a get() racing the kickoff finds them
             self._result_futures[ref.id] = asyncio.Future(loop=self.loop)
+        if streaming:
+            # per-stream state the executor's StreamTaskReturn RPCs fill
+            self._streams[task_id.binary()] = {
+                "produced": 0, "total": None, "error": None,
+                "event": asyncio.Event()}
 
         def _kickoff():
             self._register_lineage(task_id, record)
             asyncio.ensure_future(self._drive_task_prepared(remote_fn, record))
 
         self._queue_kickoff(_kickoff)
-        return refs[0] if opts.num_returns == 1 else refs
+        if streaming:
+            from ray_tpu.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(self, task_id, self.address)
+        return refs[0] if nret == 1 else refs
 
     async def _drive_task_prepared(self, remote_fn, record: dict):
         """Resolve the (cached) function key + runtime env, then drive."""
@@ -1419,13 +1471,26 @@ class CoreWorker:
         spec: TaskSpec = record["spec"]
         opts: TaskOptions = spec.options
         await self._resolve_dependencies(record)
+        if record.get("_cancelled"):
+            from ray_tpu.exceptions import TaskCancelledError
+
+            self._complete_error(record, TaskCancelledError())
+            return
         pool = self._lease_pool_for(opts, opts.required_resources())
         record["_done"] = asyncio.Event()
         pool.submit(record)
         if wait:
             await record["_done"].wait()
 
-    def _complete_ok(self, record, results):
+    def _complete_ok(self, record, results, stream_count=None):
+        record["_completed"] = True
+        if record["spec"].num_returns == -1:
+            st = self._streams.get(record["spec"].task_id.binary())
+            if st is not None:
+                st["total"] = stream_count if stream_count is not None \
+                    else st["produced"]
+                ev, st["event"] = st["event"], asyncio.Event()
+                ev.set()
         for oid, (kind, payload) in zip(record["return_ids"], results):
             if kind == "inline":
                 inband, buffers = read_blob(payload)
@@ -1444,7 +1509,18 @@ class CoreWorker:
                 self._schedule_free(oid.binary())
 
     def _complete_error(self, record, err: TaskError):
+        record["_completed"] = True
+        streaming = record["spec"].num_returns == -1
+        if streaming:
+            st = self._streams.get(record["spec"].task_id.binary())
+            if st is not None:
+                st["error"] = err
+                ev, st["event"] = st["event"], asyncio.Event()
+                ev.set()
         for oid in record["return_ids"]:
+            if streaming and (oid in self.memory_store
+                              or self._in_store.get(oid)):
+                continue  # already-yielded items stay readable
             self.memory_store[oid] = err
             fut = self._result_futures.get(oid)
             if fut is not None and not fut.done():
@@ -1690,6 +1766,61 @@ class CoreWorker:
                 self._complete_error(record, pickle.loads(reply["error"]))
             return
 
+    def stream_next(self, task_id: TaskID, index: int,
+                    timeout: float = 3600.0):
+        """Blocking wait for the index-th streamed return of a generator
+        task; returns its ObjectRef, or raises StopIteration/the error."""
+        tid_b = task_id.binary()
+
+        async def _wait():
+            deadline = time.monotonic() + timeout
+            while True:
+                st = self._streams.get(tid_b)
+                if st is None:
+                    return "stopped"
+                if index < st["produced"]:
+                    return "item"
+                if st["error"] is not None:
+                    return st["error"]
+                if st["total"] is not None and index >= st["total"]:
+                    return "stopped"
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise GetTimeoutError(
+                        f"timed out waiting for streamed return {index}")
+                try:
+                    await asyncio.wait_for(
+                        asyncio.shield(st["event"].wait()), remaining)
+                except asyncio.TimeoutError:
+                    pass
+
+        out = self._run(_wait())
+        if out == "item":
+            oid = ObjectID.for_task_return(task_id, index)
+            ref = ObjectRef(oid, self.address)
+            # the ref now carries the count: hand over the arrival pin
+            st = self._streams.get(tid_b)
+            if st is not None and oid.binary() in st.get("pinned", set()):
+                st["pinned"].discard(oid.binary())
+                self.ref_counter.unpin(oid.binary())
+            return ref
+        if out == "stopped":
+            raise StopIteration
+        raise out  # the task's error
+
+    def stream_release(self, task_id: TaskID):
+        """Generator handle dropped: release arrival pins for unconsumed
+        items and forget the stream (GC-safe: lock-based, no loop hop)."""
+        st = self._streams.pop(task_id.binary(), None)
+        if not st:
+            return
+        for oid_b in st.get("pinned", ()):
+            try:
+                self.ref_counter.unpin(oid_b)
+            except Exception:
+                pass
+        st["pinned"] = set()
+
     def get_actor(self, name: str, namespace: Optional[str] = None):
         from ray_tpu.actor import ActorHandle
 
@@ -1713,7 +1844,54 @@ class CoreWorker:
             "actor_id": handle.actor_id.binary(), "no_restart": no_restart}))
 
     def cancel(self, ref, force=False, recursive=True):
-        pass  # cooperative cancellation lands with the C++ runtime tier
+        """Cancel a task (reference: CoreWorker::CancelTask paths in
+        core_worker.cc). A still-queued task completes immediately with
+        TaskCancelledError; a running task gets TaskCancelledError raised
+        into its thread (cooperative), or its worker killed with
+        force=True. Finished tasks are a no-op. Actor tasks are not
+        cancellable (matches the reference's sync-actor limitation).
+        ``recursive`` is accepted for API parity; this runtime does not
+        track child-task trees. Accepts an ObjectRef or an
+        ObjectRefGenerator (streaming task)."""
+        from ray_tpu.object_ref import ObjectRefGenerator
+
+        if isinstance(ref, ObjectRefGenerator):
+            task_id = ref._task_id
+        else:
+            task_id = ref.id.task_id()
+        self._run(self._cancel_async(task_id, force))
+
+    async def _cancel_async(self, task_id: TaskID, force: bool):
+        from ray_tpu.exceptions import TaskCancelledError
+
+        rec = self._tasks.get(task_id)
+        if rec is None:
+            return  # finished-and-released or unknown: no-op
+        if rec["spec"].actor_id is not None:
+            raise ValueError("actor tasks cannot be cancelled")
+        if rec.get("_completed"):
+            return  # finished: never signal (or force-kill!) its worker
+        rec["_cancelled"] = True
+        # still queued in a lease pool: complete it right here
+        for pool in self._lease_cache.values():
+            if rec in pool.pending:
+                try:
+                    pool.pending.remove(rec)
+                except ValueError:
+                    break
+                self._complete_error(rec, TaskCancelledError())
+                return
+        addr = rec.get("_pushed_to")
+        if addr:
+            try:
+                await self._worker_client(addr).call(
+                    "CancelTask", pickle.dumps(
+                        {"task_id": rec["spec"].task_id.binary(),
+                         "force": force}), timeout=10.0, retries=1)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass  # worker already gone: the push failure completes it
+        # else: awaiting dependency resolution — the resolver checks the
+        # flag before the record can become push-eligible
 
     # ------------------------------------------------------------------
     # cluster info
@@ -1750,7 +1928,8 @@ class CoreWorker:
                     run.clear()
 
             for spec in req["specs"]:
-                if spec.actor_id is None and not spec.is_actor_creation:
+                if spec.actor_id is None and not spec.is_actor_creation \
+                        and spec.num_returns != -1:
                     run.append(spec)
                 else:
                     await _flush_run()
@@ -1790,6 +1969,58 @@ class CoreWorker:
                 if done or self._shutdown or time.monotonic() > deadline:
                     return pickle.dumps({"done": done})
                 await asyncio.sleep(0.2)
+        if method == "StreamTaskReturn":
+            # executor pushing one streamed yield (reference: the dynamic
+            # return objects a generator task reports to its owner)
+            req = pickle.loads(payload)
+            tid_b = req["task_id"]
+            rec = self._tasks.get(TaskID(tid_b))
+            if rec is not None and req.get("attempt", 0) != rec.get("epoch", 0):
+                # zombie attempt: a retry superseded this execution — its
+                # items must not interleave into the current stream
+                return pickle.dumps({"status": "stale_attempt"})
+            oid = ObjectID.for_task_return(TaskID(tid_b), req["index"])
+            if req["kind"] == "inline":
+                inband, buffers = read_blob(req["blob"])
+                self.memory_store[oid] = deserialize(inband, buffers)
+            else:
+                self._in_store[oid] = True
+            if rec is not None and oid not in rec["return_ids"]:
+                rec["return_ids"].append(oid)
+            st = self._streams.get(tid_b)
+            if st is not None:
+                if oid.binary() not in st.setdefault("pinned", set()):
+                    # pin until the consumer mints the ref (or the
+                    # generator is released): completion must not free
+                    # items the consumer has not reached yet
+                    st["pinned"].add(oid.binary())
+                    self.ref_counter.pin(oid.binary())
+                st["produced"] = max(st["produced"], req["index"] + 1)
+                ev, st["event"] = st["event"], asyncio.Event()
+                ev.set()
+            return pickle.dumps({"status": "ok"})
+        if method == "CancelTask":
+            # reference: HandleCancelTask — cooperative raise into the
+            # executing thread, or force-exit the worker process
+            req = pickle.loads(payload)
+            if req.get("force"):
+                logger.warning("force-cancel: worker exiting")
+                self.loop.call_later(0.05, os._exit, 1)
+                return pickle.dumps({"status": "ok"})
+            from ray_tpu.exceptions import TaskCancelledError
+
+            ident = self._running_tasks.get(req["task_id"])
+            if ident is not None:
+                import ctypes
+
+                n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_long(ident), ctypes.py_object(TaskCancelledError))
+                if n != 1:  # thread already gone: fall back to the flag
+                    logger.warning("cancel async-exc hit %d threads", n)
+                    self._cancelled_pending.add(req["task_id"])
+            else:
+                self._cancelled_pending.add(req["task_id"])
+            return pickle.dumps({"status": "ok"})
         if method == "Ping":
             return pickle.dumps({"status": "ok", "pid": os.getpid()})
         if method == "GetDeviceObject":
@@ -1868,6 +2099,8 @@ class CoreWorker:
             return await self._exec_actor_creation(spec)
         if spec.actor_id is not None:
             return await self._exec_actor_task(spec)
+        if spec.num_returns == -1:
+            return await self._exec_streaming_task(spec)
         return await self._exec_normal_task(spec)
 
     def _ensure_pool(self, size: int, replace: bool = False):
@@ -1949,6 +2182,91 @@ class CoreWorker:
                 spec, result, err, borrows=self._surviving_borrows(seen)))
         return replies
 
+    async def _exec_streaming_task(self, spec: TaskSpec) -> bytes:
+        """num_returns="streaming": run the user generator, shipping each
+        yield to the owner AS PRODUCED via StreamTaskReturn (awaited, so
+        the stream is naturally 1-deep backpressured); the final reply
+        carries the total count. Reference: the dynamic-returns generator
+        protocol in task_manager.cc + generator_waiter.cc."""
+        from ray_tpu.exceptions import TaskCancelledError
+
+        if self.job_id.is_nil():
+            self.job_id = spec.job_id
+        fn = await self._fetch_function(spec.function_key)
+        args, kwargs, seen_refs = await self._resolve_args(spec.args_blob)
+        self._ensure_pool(1)
+        owner = self._worker_client(spec.owner_address)
+        tid_b = spec.task_id.binary()
+        t0 = time.time()
+
+        def _start():
+            # cancellation registration is scoped to user-code execution
+            # only (here and in _step): between steps this worker thread
+            # runs OTHER work, and an async-exc into an ident not running
+            # this task would cancel a stranger or kill the pool thread
+            if tid_b in self._cancelled_pending:
+                self._cancelled_pending.discard(tid_b)
+                return None, TaskCancelledError(
+                    "TaskCancelledError: cancelled before execution", "")
+            self._running_tasks[tid_b] = threading.get_ident()
+            try:
+                return fn(*args, **kwargs), None
+            except Exception as e:
+                return None, TaskError(repr(e), traceback.format_exc())
+            finally:
+                self._running_tasks.pop(tid_b, None)
+
+        gen, err = await self.loop.run_in_executor(self._exec_pool, _start)
+        if err is None and not hasattr(gen, "__next__"):
+            err = TaskError(
+                f"num_returns='streaming' task {spec.function_key[:12]} did "
+                f"not return a generator (got {type(gen).__name__})", "")
+        index = 0
+        while err is None:
+            def _step():
+                if tid_b in self._cancelled_pending:
+                    self._cancelled_pending.discard(tid_b)
+                    return None, True, TaskCancelledError()
+                self._running_tasks[tid_b] = threading.get_ident()
+                try:
+                    return next(gen), False, None
+                except StopIteration:
+                    return None, True, None
+                except TaskCancelledError as e:
+                    return None, True, e
+                except Exception as e:
+                    return None, True, TaskError(repr(e),
+                                                 traceback.format_exc())
+                finally:
+                    self._running_tasks.pop(tid_b, None)
+            value, done, err = await self.loop.run_in_executor(
+                self._exec_pool, _step)
+            if done:
+                break
+            oid = ObjectID.for_task_return(spec.task_id, index)
+            inband, buffers = serialize(value)
+            total = len(inband) + sum(b.nbytes for b in buffers)
+            if total < RAY_CONFIG.object_inline_max_bytes:
+                payload = {"task_id": tid_b, "index": index,
+                           "kind": "inline", "attempt": spec.attempt,
+                           "blob": pack_blob(inband, buffers)}
+            else:
+                await self._store_blob(oid, inband, buffers, spec.attempt)
+                payload = {"task_id": tid_b, "index": index,
+                           "kind": "store", "attempt": spec.attempt}
+            await owner.call("StreamTaskReturn", pickle.dumps(payload),
+                             timeout=60.0, retries=2)
+            index += 1
+        self._trace_task(spec, getattr(fn, "__name__", "stream"), t0, err)
+        del args, kwargs, gen
+        if err is not None:
+            return pickle.dumps({"status": "app_error",
+                                 "error": pickle.dumps(err)})
+        reply = await self._pack_results(
+            spec, None, None, borrows=self._surviving_borrows(seen_refs))
+        reply["stream_count"] = index
+        return pickle.dumps(reply)
+
     def _trace_task(self, spec: TaskSpec, name: str, t0: float, err,
                     t1: Optional[float] = None):
         """Span per executed task (reference: profile_event.cc into the
@@ -1965,15 +2283,26 @@ class CoreWorker:
             task_id=spec.task_id.hex(), ok=err is None)
 
     def _call_user_fn(self, fn, args, kwargs, spec: TaskSpec):
+        from ray_tpu.exceptions import TaskCancelledError
+
+        tid_b = spec.task_id.binary()
+        if tid_b in self._cancelled_pending:
+            self._cancelled_pending.discard(tid_b)
+            return None, TaskCancelledError(
+                "TaskCancelledError: cancelled before execution started", "")
+        self._running_tasks[tid_b] = threading.get_ident()
         self._tls.task_id = spec.task_id
         try:
             result = fn(*args, **kwargs)
             if asyncio.iscoroutine(result):
                 result = asyncio.run(result)
             return result, None
+        except TaskCancelledError as e:
+            return None, e
         except Exception as e:
             return None, TaskError(repr(e), traceback.format_exc())
         finally:
+            self._running_tasks.pop(tid_b, None)
             self._tls.task_id = None
 
     async def _resolve_args(self, args_blob: bytes):
@@ -2018,8 +2347,8 @@ class CoreWorker:
         if err is not None:
             return {"status": "app_error", "error": pickle.dumps(err)}
         values: List[Any]
-        if spec.num_returns == 0:
-            values = []
+        if spec.num_returns <= 0:  # 0 returns, or -1 = streaming (items
+            values = []            # already shipped via StreamTaskReturn)
         elif spec.num_returns == 1:
             values = [result]
         else:

@@ -34,6 +34,16 @@ class OutOfMemoryError(TaskError):
     ray.exceptions.OutOfMemoryError; raylet worker_killing_policy)."""
 
 
+class TaskCancelledError(TaskError):
+    """The task was cancelled via ray_tpu.cancel (reference:
+    ray.exceptions.TaskCancelledError). Default-constructible: cooperative
+    cancellation raises this CLASS into the running task's thread."""
+
+    def __init__(self, cause_repr: str = "TaskCancelledError: task was cancelled",
+                 traceback_str: str = "", cause: BaseException | None = None):
+        super().__init__(cause_repr, traceback_str, cause)
+
+
 class ActorError(RayTpuError):
     pass
 

@@ -121,3 +121,37 @@ def collect_deserialized_refs():
         yield out
     finally:
         _deserialized_refs.refs = prev
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's dynamically-created return refs
+    (reference: ray.ObjectRefGenerator for num_returns="streaming",
+    generator_waiter.cc). Each __next__ blocks until the executor has
+    streamed the next yield to the owner, then hands back its ObjectRef;
+    exhausts with StopIteration when the generator completes."""
+
+    def __init__(self, core, task_id, owner_address: str):
+        self._core = core
+        self._task_id = task_id
+        self._owner_address = owner_address
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ref = self._core.stream_next(self._task_id, self._index)
+        self._index += 1
+        return ref
+
+    def __repr__(self):
+        return (f"ObjectRefGenerator(task={self._task_id.hex()[:12]}, "
+                f"next_index={self._index})")
+
+    def __del__(self):
+        # release arrival pins for items never consumed (lock-based, safe
+        # from GC on any thread)
+        try:
+            self._core.stream_release(self._task_id)
+        except Exception:
+            pass
