@@ -246,7 +246,10 @@ class _LeasePool:
             else:
                 err: TaskError = pickle.loads(res["error"])
                 opts = record["spec"].options
-                if opts.retry_exceptions \
+                from ray_tpu.exceptions import StrayInterrupt
+
+                stray = isinstance(getattr(err, "cause", None), StrayInterrupt)
+                if (opts.retry_exceptions or stray) \
                         and not isinstance(err, TaskCancelledError) \
                         and record["attempts"] < record["max_retries"]:
                     record["attempts"] += 1
@@ -502,9 +505,11 @@ class CoreWorker:
         # executor state
         self._fn_cache: Dict[str, Any] = {}
         # cancellation: running task_id -> executing thread ident, plus
-        # cancels that arrived before their task started
+        # cancels that arrived before their task started, plus every tid a
+        # cancel was requested for (stray async-exc detection)
         self._running_tasks: Dict[bytes, int] = {}
         self._cancelled_pending: set = set()
+        self._cancel_requested: set = set()
         # streaming generators: task_id -> {produced, total, error, event}
         # (reference: task_manager.cc dynamic return handling)
         self._streams: Dict[bytes, dict] = {}
@@ -1574,9 +1579,11 @@ class CoreWorker:
             if hasattr(strat, "node_id"):
                 nodes = (await self._gcs_call("GetAllNodes", {}))["nodes"]
                 for n in nodes:
-                    if n["node_id"] == strat.node_id:
+                    if n["node_id"] == strat.node_id and n.get("alive", True):
                         return {"node_id": strat.node_id, "address": n["address"]}
-                return None
+                if not getattr(strat, "soft", False):
+                    return None
+                # soft affinity: fall through to the normal pick
             if hasattr(strat, "hard"):
                 selector.update(strat.hard)
                 req["selector"] = selector
@@ -1810,16 +1817,27 @@ class CoreWorker:
 
     def stream_release(self, task_id: TaskID):
         """Generator handle dropped: release arrival pins for unconsumed
-        items and forget the stream (GC-safe: lock-based, no loop hop)."""
-        st = self._streams.pop(task_id.binary(), None)
-        if not st:
+        items and forget the stream. Runs ON the io loop (scheduled from
+        GC threads) so it cannot race the StreamTaskReturn handler's
+        check-then-pin sequence and strand a pin forever."""
+        def _do():
+            st = self._streams.pop(task_id.binary(), None)
+            if not st:
+                return
+            for oid_b in st.get("pinned", ()):
+                try:
+                    self.ref_counter.unpin(oid_b)
+                except Exception:
+                    pass
+            st["pinned"] = set()
+
+        if threading.current_thread() is self._loop_thread:
+            _do()
             return
-        for oid_b in st.get("pinned", ()):
-            try:
-                self.ref_counter.unpin(oid_b)
-            except Exception:
-                pass
-        st["pinned"] = set()
+        try:
+            self.loop.call_soon_threadsafe(_do)
+        except RuntimeError:
+            _do()  # loop gone (shutdown): no handler left to race
 
     def get_actor(self, name: str, namespace: Optional[str] = None):
         from ray_tpu.actor import ActorHandle
@@ -2009,6 +2027,9 @@ class CoreWorker:
                 return pickle.dumps({"status": "ok"})
             from ray_tpu.exceptions import TaskCancelledError
 
+            self._cancel_requested.add(req["task_id"])
+            if len(self._cancel_requested) > 1024:
+                self._cancel_requested.pop()
             ident = self._running_tasks.get(req["task_id"])
             if ident is not None:
                 import ctypes
@@ -2298,6 +2319,20 @@ class CoreWorker:
                 result = asyncio.run(result)
             return result, None
         except TaskCancelledError as e:
+            if tid_b not in self._cancel_requested:
+                # an async-exc aimed at the PREVIOUS task on this thread
+                # landed late (delivery is deferred to a bytecode check):
+                # this task is an innocent victim — report it as a worker-
+                # side interruption the owner retries, not a cancellation
+                from ray_tpu.exceptions import StrayInterrupt
+
+                logger.warning("stray cancellation landed in task %s",
+                               spec.task_id.hex()[:12])
+                return None, TaskError(
+                    "task interrupted by a stray cancellation "
+                    "(async-exc delivery race); retryable", "",
+                    cause=StrayInterrupt())
+            self._cancel_requested.discard(tid_b)
             return None, e
         except Exception as e:
             return None, TaskError(repr(e), traceback.format_exc())

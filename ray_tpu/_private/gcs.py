@@ -702,6 +702,14 @@ class GcsServer:
                     selector.update(strat.hard)
                 if strat is not None and hasattr(strat, "node_id"):
                     node_id = NodeID.from_hex(strat.node_id)
+                    if getattr(strat, "soft", False) and (
+                            node_id not in self.nodes
+                            or not self.nodes[node_id].alive):
+                        # soft affinity: preferred node gone — fall back to
+                        # the normal pick instead of pinning to a corpse
+                        node_id = self._pick_node(
+                            resources, selector,
+                            waiter_id=record.actor_id.hex())
                 else:
                     node_id = self._pick_node(
                         resources, selector,
@@ -893,9 +901,14 @@ class GcsServer:
         address = record.address
         if record.state == "ALIVE" and record.node_id in self.node_clients and address:
             try:
+                # best-effort: the raylet may already be dead (node loss not
+                # yet detected) — fail FAST rather than burning the default
+                # connect/presend retry budget per kill (a group shutdown
+                # after node loss kills many actors back-to-back)
                 await self.node_clients[record.node_id].call(
-                    "KillWorker", pickle.dumps({"worker_address": address}), timeout=10.0,
-                    retries=0)
+                    "KillWorker", pickle.dumps({"worker_address": address}),
+                    timeout=10.0, retries=0, connect_timeout=2.0,
+                    presend_retries=0)
             except (RpcError, asyncio.TimeoutError, OSError):
                 pass
         if no_restart:
@@ -962,13 +975,24 @@ class GcsServer:
     async def _remove_pg(self, pg: PGRecord):
         pg.state = "REMOVED"
         self._persist_pg(pg)
+        released: set = set()
         for idx, node_id in enumerate(pg.bundle_nodes):
-            if node_id is not None and node_id in self.node_clients:
-                try:
-                    await self.node_clients[node_id].call("ReleasePGBundles", pickle.dumps(
-                        {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0, retries=1)
-                except (RpcError, asyncio.TimeoutError, OSError):
-                    pass
+            if node_id is None or node_id in released \
+                    or node_id not in self.node_clients:
+                continue
+            released.add(node_id)  # one release per node, not per bundle
+            info = self.nodes.get(node_id)
+            if info is not None and not info.alive:
+                continue  # dead node: nothing to release
+            try:
+                # one retry for LIVE nodes (a swallowed transient failure
+                # would leak the bundle reservation until raylet restart);
+                # dead raylets still fail fast via the 2s connect bound
+                await self.node_clients[node_id].call("ReleasePGBundles", pickle.dumps(
+                    {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0,
+                    retries=1, connect_timeout=2.0, presend_retries=0)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
         pg.ready_event.set()
 
     def _plan_pg(self, pg: PGRecord) -> Optional[List[NodeID]]:

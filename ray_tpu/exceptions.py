@@ -34,6 +34,11 @@ class OutOfMemoryError(TaskError):
     ray.exceptions.OutOfMemoryError; raylet worker_killing_policy)."""
 
 
+class StrayInterrupt(RayTpuError):
+    """Marker cause: a cancellation async-exc landed in the wrong task on
+    a shared executor thread; the interrupted task is retried."""
+
+
 class TaskCancelledError(TaskError):
     """The task was cancelled via ray_tpu.cancel (reference:
     ray.exceptions.TaskCancelledError). Default-constructible: cooperative

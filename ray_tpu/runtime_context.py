@@ -26,7 +26,10 @@ class RuntimeContext:
 
     def get_node_id(self) -> Optional[str]:
         nid = getattr(self._worker, "node_id", None)
-        return nid.hex() if nid is not None else None
+        if nid is not None:
+            return nid.hex()
+        # drivers connect to an existing raylet: only the hex is recorded
+        return getattr(self._worker, "node_hex", None) or None
 
     def get_worker_id(self) -> Optional[str]:
         wid = getattr(self._worker, "worker_id", None)

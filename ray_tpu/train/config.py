@@ -22,6 +22,14 @@ class ScalingConfig:
     # bootstrap jax.distributed across workers (multi-host SPMD). Defaults on
     # for multi-worker TPU groups.
     bootstrap_distributed: Optional[bool] = None
+    # elasticity (reference: scaling_policy.py:32): on worker loss, re-form
+    # the group at the largest mesh-shaped size the cluster can host
+    # instead of insisting on num_workers
+    elastic: bool = False
+    min_workers: int = 1
+    # mesh-shaped sizes only: "pow2" (powers of two) or an int slice size
+    # (group size must be a whole multiple — TPU slice granularity)
+    elastic_granularity: Any = "pow2"
 
     def bundle(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker)

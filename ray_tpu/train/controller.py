@@ -61,25 +61,45 @@ class TrainController:
     def status(self) -> Dict[str, Any]:
         return {"state": self.state, "metrics": dict(self.latest_metrics)}
 
+    def _shards_for(self, size: int) -> Optional[List[bytes]]:
+        """Dataset shards for a (possibly resized) group. An elastic shrink
+        keeps the first ``size`` rank shards; the removed ranks' shards are
+        dropped with a warning (full re-sharding needs the dataset layer)."""
+        if self.shards_per_rank is None:
+            return None
+        if size < len(self.shards_per_rank):
+            import logging
+
+            logging.getLogger("ray_tpu.train").warning(
+                "elastic shrink to %d workers drops the dataset shards of "
+                "ranks >= %d for this restart", size, size)
+        return self.shards_per_rank[:size]
+
     def run(self) -> Dict[str, Any]:
+        from ray_tpu.train.scaling_policy import make_scaling_policy, sized
         from ray_tpu.train.worker_group import WorkerGroup
 
         failures = 0
         max_failures = self.run_config.failure_config.max_failures
         last_error = None
+        policy = make_scaling_policy(self.scaling)
+        size = policy.initial_size(ray_tpu.available_resources())
+        if size < 1:
+            size = self.scaling.num_workers  # scheduler queues until ready
         while True:
             self.state = "SCHEDULING"
-            group = WorkerGroup(self.scaling)
+            scaling = sized(self.scaling, size)
+            group = WorkerGroup(scaling)
             try:
-                bootstrap = self.scaling.bootstrap_distributed
+                bootstrap = scaling.bootstrap_distributed
                 if bootstrap is None:
-                    bootstrap = self.scaling.use_tpu and self.scaling.num_workers > 1
-                if bootstrap and self.scaling.num_workers > 1:
+                    bootstrap = scaling.use_tpu and size > 1
+                if bootstrap and size > 1:
                     group.bootstrap_distributed()
                 self.state = "RUNNING"
                 refs = group.run(self.fn_blob, self.config, self._self_handle,
                                  self.manager.latest(), self.run_dir,
-                                 self.shards_per_rank)
+                                 self._shards_for(size))
                 results = ray_tpu.get(refs, timeout=24 * 3600)
                 self.state = "FINISHED"
                 latest = self.manager.latest()
@@ -88,6 +108,7 @@ class TrainController:
                         results[0].get("result") if isinstance(results[0], dict)
                         else {}),
                     "checkpoint_path": latest.path if latest else None,
+                    "num_workers": size,
                     "error": None,
                 }
             except TaskError as e:
@@ -100,9 +121,42 @@ class TrainController:
                     return {
                         "metrics": self.latest_metrics,
                         "checkpoint_path": latest.path if latest else None,
+                        "num_workers": size,
                         "error": f"train workers failed {failures}x "
                                  f"(max_failures={max_failures}): {last_error[:2000]}",
                     }
-                time.sleep(1.0)
+                group.shutdown()  # release resources BEFORE sizing the retry
+                group = None
+                if self.scaling.elastic:
+                    # settle: node-death detection (GCS heartbeat timeout)
+                    # and lease release take several seconds — size from a
+                    # view taken AFTER the detection window and stable
+                    # across two samples, or an elastic resize could
+                    # target dead capacity
+                    time.sleep(4.0)
+                    avail = ray_tpu.available_resources()
+                    for _ in range(10):
+                        time.sleep(1.5)
+                        nxt = ray_tpu.available_resources()
+                        if nxt == avail:
+                            break
+                        avail = nxt
+                else:
+                    time.sleep(1.0)
+                    avail = {}  # fixed policy ignores the view
+                new_size = policy.size_after_failure(size, avail)
+                if new_size is None:
+                    latest = self.manager.latest()
+                    self.state = "ERRORED"
+                    return {
+                        "metrics": self.latest_metrics,
+                        "checkpoint_path": latest.path if latest else None,
+                        "num_workers": size,
+                        "error": ("cluster below the elastic minimum "
+                                  f"({self.scaling.min_workers} workers): "
+                                  f"{last_error[:1500]}"),
+                    }
+                size = new_size
             finally:
-                group.shutdown()
+                if group is not None:
+                    group.shutdown()

@@ -74,9 +74,20 @@ class DataParallelTrainer:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         run_dir = self._run_dir()
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        # pin the controller to the DRIVER's node (reference: the
+        # controller runs alongside the driver): a controller placed on an
+        # arbitrary worker node would die with it, taking down the very
+        # failure handling that should survive node loss
         controller = TrainController.options(
             num_cpus=0.1, max_concurrency=8,
             name=f"train_controller_{uuid.uuid4().hex[:8]}",
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=ray_tpu.get_runtime_context().get_node_id(),
+                soft=True),
         ).remote(
             cloudpickle.dumps(self.train_loop_per_worker),
             self.train_loop_config,
