@@ -59,6 +59,34 @@ def main() -> int:
     return 0
 
 
+def _measure(cfg, mesh_devices, batch, seq, steps, warmup, peak):
+    """One config's (mfu, tokens/s) on the given devices."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.parallel import TrainStepBundle, create_mesh, make_optimizer
+
+    cfg = dataclasses.replace(cfg, max_seq_len=seq)
+    mesh = create_mesh({"data": 1, "fsdp": 1, "seq": 1, "tensor": 1,
+                        "expert": 1}, devices=mesh_devices)
+    bundle = TrainStepBundle(cfg, mesh, optimizer=make_optimizer(
+        learning_rate=1e-4, warmup_steps=10, total_steps=1000))
+    params, opt_state = bundle.init(jax.random.PRNGKey(0))
+    batch_data = bundle.make_batch(np.random.default_rng(0), batch, seq)
+    for _ in range(warmup):
+        params, opt_state, loss = bundle.step(params, opt_state, batch_data)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = bundle.step(params, opt_state, batch_data)
+    float(loss)
+    dt = (time.perf_counter() - t0) / steps
+    tps = batch * seq / dt
+    return tps * cfg.flops_per_token() / peak, tps
+
+
 def _attempt():
     t_start = time.time()
     config_name = os.environ.get("RAY_TPU_BENCH_CONFIG", "")
@@ -129,6 +157,23 @@ def _attempt():
             "seq": seq,
             "wall_s": round(time.time() - t_start, 1),
         }
+        # release the primary config's HBM before the secondary allocates
+        del params, opt_state, bundle, batch_data
+        if on_tpu and config_name == "1b" and not os.environ.get(
+                "RAY_TPU_BENCH_SKIP_SECONDARY"):
+            # secondary config (VERDICT r3: report 350m too). b8/s1024 is
+            # the best measured 350m fine-tune shape on one chip; the
+            # pallas flash BACKWARD kernels (head_dim 64) carry it past
+            # the 35% target.
+            try:
+                mfu2, tps2 = _measure(CONFIGS["350m"], mesh_devices=devices[:1],
+                                      batch=8, seq=1024, steps=6, warmup=2,
+                                      peak=peak)
+                result["mfu_350m"] = round(mfu2, 4)
+                result["tokens_per_sec_350m"] = round(tps2, 1)
+                result["vs_target_350m"] = round(mfu2 / 0.35, 4)
+            except Exception as e:  # secondary must never sink the bench
+                result["mfu_350m_error"] = str(e)[:160]
         return 0, result
     except Exception as e:  # always emit a parseable line
         import traceback

@@ -8,7 +8,15 @@ V-trace). All learners are jitted jax programs; env runners are actors.
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.appo import APPO, APPOConfig
 from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    RendezvousEnv,
+    register_multi_agent_env,
+)
 from ray_tpu.rl.offline import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rl.ppo import PPO, PPOConfig, PPOLearner
 from ray_tpu.rl.sac import SAC, SACConfig
@@ -25,5 +33,8 @@ __all__ = [
     "PPO", "PPOConfig", "PPOLearner",
     "DQN", "DQNConfig",
     "IMPALA", "IMPALAConfig",
+    "APPO", "APPOConfig",
+    "MultiAgentEnv", "MultiAgentPPO", "MultiAgentPPOConfig",
+    "RendezvousEnv", "register_multi_agent_env",
     "ReplayBuffer", "PrioritizedReplayBuffer",
 ]

@@ -1,0 +1,128 @@
+"""APPO: asynchronous PPO — IMPALA's async runner/aggregator architecture
+with a PPO clipped-surrogate learner over V-trace-corrected advantages and
+a target network for stable value targets.
+
+Reference: rllib/algorithms/appo/appo.py:347 (training_step: IMPALA
+sampling + surrogate loss + periodic target-network sync + optional KL
+term). The learner is one jitted program: V-trace (lax.scan over time)
+runs on the ONLINE value function and online/behavior ratios (as in the
+rllib learner); the TARGET network's role is the optional KL anchor and
+a stable policy snapshot — no host loops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+
+
+@dataclass
+class APPOConfig(IMPALAConfig):
+    clip_param: float = 0.2
+    # updates between target-network syncs (reference:
+    # appo.py target_network_update_freq, counted here in learner updates)
+    target_update_freq: int = 4
+    use_kl_loss: bool = False
+    kl_coeff: float = 0.2
+
+    @property
+    def algo_cls(self):
+        return APPO
+
+
+class APPO(IMPALA):
+    """Inherits the async pipeline (runners, aggregators, relaunch loop);
+    replaces the learner update with the APPO loss + target network."""
+
+    def __init__(self, cfg: APPOConfig):
+        super().__init__(cfg)
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+        import optax
+
+        self.target_params = self.params
+        self._updates_done = 0
+
+        from ray_tpu.rl.impala import vtrace_returns
+
+        def vtrace(values, last_value, rewards, dones, rhos):
+            return vtrace_returns(
+                values, last_value, rewards, dones, rhos, gamma=cfg.gamma,
+                rho_clip=cfg.vtrace_rho_clip, c_clip=cfg.vtrace_c_clip)
+
+        def loss_fn(params, target_params, batch):
+            T, B = batch["actions"].shape
+            obs_flat = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
+            obs_all = jnp.concatenate([obs_flat, batch["last_obs"]], axis=0)
+            logits_all, values_all = self.model.apply({"params": params},
+                                                      obs_all)
+            logits = logits_all[: T * B].reshape(T, B, -1)
+            values = values_all[: T * B].reshape(T, B)
+            last_value = values_all[T * B:]
+            # the target network serves the KL anchor (reference: rllib
+            # APPO — V-trace itself runs on the ONLINE value function)
+            t_logits_all, _ = self.model.apply(
+                {"params": target_params}, obs_all)
+            t_logits = t_logits_all[: T * B].reshape(T, B, -1)
+
+            acts = batch["actions"][..., None].astype(jnp.int32)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, acts, axis=-1)[..., 0]
+            t_logp_all = jax.nn.log_softmax(t_logits)
+
+            ratio = jnp.exp(logp - batch["behavior_logp"])
+            vs, pg_adv = vtrace(jax.lax.stop_gradient(values),
+                                jax.lax.stop_gradient(last_value),
+                                batch["rewards"], batch["dones"],
+                                jax.lax.stop_gradient(ratio))
+            adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+
+            surr1 = ratio * adv
+            surr2 = jnp.clip(ratio, 1 - cfg.clip_param,
+                             1 + cfg.clip_param) * adv
+            pg_loss = -jnp.minimum(surr1, surr2).mean()
+            vf_loss = ((values - vs) ** 2).mean()
+            entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+            total = pg_loss + cfg.vf_coef * vf_loss - cfg.entropy_coef * entropy
+            if cfg.use_kl_loss:
+                kl = (jnp.exp(t_logp_all)
+                      * (t_logp_all - logp_all)).sum(-1).mean()
+                total = total + cfg.kl_coeff * kl
+            return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy, "mean_ratio": ratio.mean()}
+
+        def appo_update(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, target_params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, **aux}
+
+        self._appo_update = jax.jit(appo_update)
+
+        def update(params, opt_state, batch):
+            params, opt_state, metrics = self._appo_update(
+                params, self.target_params, opt_state, batch)
+            self._updates_done += 1
+            if self._updates_done % cfg.target_update_freq == 0:
+                self.target_params = params
+            return params, opt_state, metrics
+
+        self._update = update  # IMPALA.training_step drives this
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state["target_params"] = self._to_np(self.target_params)
+        state["updates_done"] = self._updates_done
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        self.target_params = state.get("target_params", self.params)
+        self._updates_done = state.get("updates_done", 0)

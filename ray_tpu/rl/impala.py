@@ -21,6 +21,35 @@ import ray_tpu
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 
 
+def vtrace_returns(values, last_value, rewards, dones, rhos, *, gamma,
+                   rho_clip, c_clip):
+    """V-trace targets + pg advantages over [T, B] inputs (Espeholt et al.
+    2018), scanned backwards in time. Shared by the IMPALA and APPO
+    learners — one implementation to keep their corrections in sync."""
+    from ray_tpu.utils import import_jax
+
+    jax = import_jax()
+    import jax.numpy as jnp
+
+    rho_cl = jnp.minimum(rhos, rho_clip)
+    c_cl = jnp.minimum(rhos, c_clip)
+    nonterm = 1.0 - dones
+    values_tp1 = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    deltas = rho_cl * (rewards + gamma * values_tp1 * nonterm - values)
+
+    def body(carry, xs):
+        delta, c, nt = xs
+        carry = delta + gamma * nt * c * carry
+        return carry, carry
+
+    _, acc = jax.lax.scan(body, jnp.zeros_like(last_value),
+                          (deltas, c_cl, nonterm), reverse=True)
+    vs = values + acc
+    vs_tp1 = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = rho_cl * (rewards + gamma * vs_tp1 * nonterm - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
 @dataclass
 class IMPALAConfig(AlgorithmConfig):
     num_env_runners: int = 2
@@ -160,27 +189,9 @@ class IMPALA(Algorithm):
         self._jax = jax
 
         def vtrace(values, last_value, rewards, dones, rhos):
-            """[T, B] inputs -> (vs, pg_adv), scanned backwards in time."""
-            rho_cl = jnp.minimum(rhos, cfg.vtrace_rho_clip)
-            c_cl = jnp.minimum(rhos, cfg.vtrace_c_clip)
-            nonterm = 1.0 - dones
-            values_tp1 = jnp.concatenate(
-                [values[1:], last_value[None]], axis=0)
-            deltas = rho_cl * (rewards + cfg.gamma * values_tp1 * nonterm
-                               - values)
-
-            def body(carry, xs):
-                delta, c, nt, v_tp1 = xs
-                carry = delta + cfg.gamma * nt * c * carry
-                return carry, carry
-
-            _, acc = jax.lax.scan(
-                body, jnp.zeros_like(last_value),
-                (deltas, c_cl, nonterm, values_tp1), reverse=True)
-            vs = values + acc
-            vs_tp1 = jnp.concatenate([vs[1:], last_value[None]], axis=0)
-            pg_adv = rho_cl * (rewards + cfg.gamma * vs_tp1 * nonterm - values)
-            return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+            return vtrace_returns(
+                values, last_value, rewards, dones, rhos, gamma=cfg.gamma,
+                rho_clip=cfg.vtrace_rho_clip, c_clip=cfg.vtrace_c_clip)
 
         def loss_fn(params, batch):
             T, B = batch["actions"].shape

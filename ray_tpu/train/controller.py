@@ -89,8 +89,16 @@ class TrainController:
         while True:
             self.state = "SCHEDULING"
             scaling = sized(self.scaling, size)
-            group = WorkerGroup(scaling)
+            group = None
             try:
+                # bounded group formation on elastic retries: if a stale
+                # availability view sized too big, the PG never becomes
+                # ready — fail into the retry loop (which re-sizes from a
+                # fresher view) instead of hanging on it
+                group = WorkerGroup(
+                    scaling,
+                    ready_timeout=60.0 if (failures and self.scaling.elastic)
+                    else 600.0)
                 bootstrap = scaling.bootstrap_distributed
                 if bootstrap is None:
                     bootstrap = scaling.use_tpu and size > 1
@@ -111,7 +119,7 @@ class TrainController:
                     "num_workers": size,
                     "error": None,
                 }
-            except TaskError as e:
+            except Exception as e:  # worker failure, PG timeout, node loss
                 last_error = str(e)
                 failures += 1
                 self.state = "RESTARTING"
@@ -125,15 +133,19 @@ class TrainController:
                         "error": f"train workers failed {failures}x "
                                  f"(max_failures={max_failures}): {last_error[:2000]}",
                     }
-                group.shutdown()  # release resources BEFORE sizing the retry
+                if group is not None:  # creation itself may have raised
+                    group.shutdown()  # release resources BEFORE re-sizing
                 group = None
                 if self.scaling.elastic:
-                    # settle: node-death detection (GCS heartbeat timeout)
-                    # and lease release take several seconds — size from a
-                    # view taken AFTER the detection window and stable
-                    # across two samples, or an elastic resize could
-                    # target dead capacity
-                    time.sleep(4.0)
+                    # settle: size from a view taken AFTER the GCS node
+                    # death-detection window (health_check timeout + slack)
+                    # and stable across samples, or an elastic resize could
+                    # target capacity that is about to be marked dead
+                    from ray_tpu._private.config import RAY_CONFIG
+
+                    time.sleep(
+                        (RAY_CONFIG.health_check_timeout_ms
+                         + 3 * RAY_CONFIG.health_check_period_ms) / 1000.0)
                     avail = ray_tpu.available_resources()
                     for _ in range(10):
                         time.sleep(1.5)

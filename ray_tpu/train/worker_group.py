@@ -98,8 +98,10 @@ class TrainWorker:
 
 
 class WorkerGroup:
-    def __init__(self, scaling: ScalingConfig, name_prefix: str = "train"):
+    def __init__(self, scaling: ScalingConfig, name_prefix: str = "train",
+                 ready_timeout: float = 600.0):
         self.scaling = scaling
+        self.ready_timeout = ready_timeout
         self.workers: List[Any] = []
         self.pg: Optional[PlacementGroup] = None
         self.slice_pg = None
@@ -107,6 +109,7 @@ class WorkerGroup:
 
     def _create(self):
         n = self.scaling.num_workers
+        timeout = self.ready_timeout
         if self.scaling.use_tpu:
             from ray_tpu.util.tpu import slice_placement_group
 
@@ -114,8 +117,16 @@ class WorkerGroup:
                 self.slice_pg = slice_placement_group(
                     num_hosts=n, pod_type=self.scaling.topology,
                     chips_per_host=self.scaling.chips_per_worker or None)
-                self.slice_pg.ready(timeout=600)
-                self.pg = self.slice_pg.placement_group
+                if self.slice_pg.ready(timeout=timeout):
+                    self.pg = self.slice_pg.placement_group
+                else:
+                    # unready slice reservation must be released, not
+                    # silently scheduled against (leaks across retries)
+                    try:
+                        remove_placement_group(self.slice_pg.placement_group)
+                    except Exception:
+                        pass
+                    self.slice_pg = None
             except Exception:
                 self.pg = None  # fall through to plain PG
         if self.pg is None:
@@ -124,7 +135,17 @@ class WorkerGroup:
                 strategy=self.scaling.placement_strategy
                 if self.scaling.placement_strategy in
                 ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD") else "SPREAD")
-            self.pg.ready(timeout=600)
+            if not self.pg.ready(timeout=timeout):
+                from ray_tpu.exceptions import PlacementGroupError
+
+                pg, self.pg = self.pg, None
+                try:
+                    remove_placement_group(pg)  # don't leak the reservation
+                except Exception:
+                    pass
+                raise PlacementGroupError(
+                    f"worker-group placement group ({n} x "
+                    f"{self.scaling.bundle()}) not ready within {timeout}s")
         res = self.scaling.bundle()
         self.workers = [
             TrainWorker.options(
@@ -137,7 +158,8 @@ class WorkerGroup:
             for i in range(n)
         ]
         # make sure every worker is alive before proceeding
-        ray_tpu.get([w.get_host_info.remote() for w in self.workers], timeout=600)
+        ray_tpu.get([w.get_host_info.remote() for w in self.workers],
+                    timeout=self.ready_timeout)
 
     def bootstrap_distributed(self):
         """Form the jax.distributed mesh across all workers (rank 0 hosts the
