@@ -513,6 +513,11 @@ class CoreWorker:
         # streaming generators: task_id -> {produced, total, error, event}
         # (reference: task_manager.cc dynamic return handling)
         self._streams: Dict[bytes, dict] = {}
+        # cross-host channel mailboxes (reader-hosted; reference:
+        # experimental_mutable_object_provider.cc cross-node channel legs):
+        # name -> {"q": deque, "data": Event, "space": Event, "cap": int}
+        self._chan_mail: Dict[str, dict] = {}
+        self._chan_closed: set = set()  # torn-down mailboxes drop pushes
         self.actor_instance = None
         self.actor_id: Optional[ActorID] = None
         # device-object transport (reference: per-actor GPUObjectStore):
@@ -1815,6 +1820,44 @@ class CoreWorker:
             raise StopIteration
         raise out  # the task's error
 
+    def _chan_mailbox(self, name: str) -> dict:
+        from collections import deque as _deque
+
+        box = self._chan_mail.get(name)
+        if box is None:
+            box = self._chan_mail[name] = {
+                "q": _deque(), "data": asyncio.Event(),
+                "space": asyncio.Event(), "cap": 2}
+        return box
+
+    def chan_pop(self, name: str, timeout: float = 300.0) -> bytes:
+        """Reader side of a cross-host channel mailbox (blocking; called
+        from the dag-loop/driver thread, never the io loop)."""
+        async def _pop():
+            box = self._chan_mailbox(name)
+            deadline = time.monotonic() + timeout
+            while not box["q"]:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"channel {name}: no value")
+                try:
+                    await asyncio.wait_for(box["data"].wait(),
+                                           min(remaining, 5.0))
+                except asyncio.TimeoutError:
+                    pass
+            blob = box["q"].popleft()
+            ev, box["space"] = box["space"], asyncio.Event()
+            ev.set()
+            return blob
+
+        return self._run(_pop(), timeout + 10.0)
+
+    def chan_close(self, name: str):
+        self._chan_mail.pop(name, None)
+        self._chan_closed.add(name)
+        if len(self._chan_closed) > 4096:
+            self._chan_closed.pop()
+
     def stream_release(self, task_id: TaskID):
         """Generator handle dropped: release arrival pins for unconsumed
         items and forget the stream. Runs ON the io loop (scheduled from
@@ -2016,6 +2059,29 @@ class CoreWorker:
                 st["produced"] = max(st["produced"], req["index"] + 1)
                 ev, st["event"] = st["event"], asyncio.Event()
                 ev.set()
+            return pickle.dumps({"status": "ok"})
+        if method == "ChanPush":
+            # cross-host channel leg: the WRITER pushes into a mailbox
+            # hosted by this (reader) worker; a full mailbox parks the
+            # push — that await IS the channel's backpressure
+            req = pickle.loads(payload)
+            if req["name"] in self._chan_closed:
+                # torn-down reader: drop the value instead of resurrecting
+                # a mailbox nothing will ever pop again
+                return pickle.dumps({"status": "closed"})
+            box = self._chan_mailbox(req["name"])
+            deadline = time.monotonic() + 300.0
+            while len(box["q"]) >= box["cap"]:
+                if time.monotonic() > deadline or self._shutdown \
+                        or req["name"] in self._chan_closed:
+                    raise RpcError(f"channel {req['name']} reader stalled")
+                try:
+                    await asyncio.wait_for(box["space"].wait(), 5.0)
+                except asyncio.TimeoutError:
+                    pass
+            box["q"].append(req["blob"])
+            ev, box["data"] = box["data"], asyncio.Event()
+            ev.set()
             return pickle.dumps({"status": "ok"})
         if method == "CancelTask":
             # reference: HandleCancelTask — cooperative raise into the

@@ -84,15 +84,26 @@ class CollectiveStore:
         self._p2p[key] = payload
         return True
 
-    async def get_p2p(self, key: str, timeout: float = 300.0):
+    async def del_p2p(self, key: str):
+        self._p2p.pop(key, None)
+        return True
+
+    async def _wait_p2p(self, key: str, timeout: float, consume: bool):
         import asyncio
 
         deadline = time.monotonic() + timeout
         while key not in self._p2p:
             if time.monotonic() > deadline:
-                raise TimeoutError(f"recv {key} timed out")
+                raise TimeoutError(f"p2p {key} timed out")
             await asyncio.sleep(0.002)
-        return self._p2p.pop(key)
+        return self._p2p.pop(key) if consume else self._p2p[key]
+
+    async def peek(self, key: str, timeout: float = 300.0):
+        """Non-consuming wait (rendezvous metadata, e.g. rank addresses)."""
+        return await self._wait_p2p(key, timeout, consume=False)
+
+    async def get_p2p(self, key: str, timeout: float = 300.0):
+        return await self._wait_p2p(key, timeout, consume=True)
 
 
 class CpuStoreGroup:
@@ -315,12 +326,121 @@ class XlaGroup:
 
         self.allreduce(jnp.zeros((self.mesh.size,), jnp.float32)).block_until_ready()
 
-    def send(self, tensor, dst_rank: int, tag: int = 0):
-        raise NotImplementedError(
-            "XLA p2p uses ppermute inside compiled programs; for eager p2p "
-            "between actors use the cpu backend or device channels")
+    # -- eager p2p via device objects (reference: the accelerator channel
+    # tier, torch_tensor_accelerator_channel.py). ICI p2p only exists
+    # inside compiled programs (ppermute above); the EAGER tier keeps the
+    # tensor resident in the sender's device store and the receiver pulls
+    # it directly from the sender's worker — no store hop, no driver hop.
 
-    recv = send
+    def _p2p_state(self):
+        if getattr(self, "_p2p", None) is None:
+            import ray_tpu
+
+            store_cls = ray_tpu.remote(CollectiveStore)
+            store = store_cls.options(
+                name=_STORE_PREFIX + self.group_name,
+                max_concurrency=max(self.world_size * 2, 8),
+                lifetime="detached", get_if_exists=True,
+                num_cpus=0.1).remote(self.world_size)
+            w = ray_tpu._private.worker.global_worker()
+            ray_tpu.get(store.put_p2p.remote(
+                f"addr:{self.group_name}:{self.rank}", w.address), timeout=60)
+            self._p2p = {"store": store, "worker": w,
+                         "send_seq": {}, "recv_seq": {}, "addrs": {}}
+        return self._p2p
+
+    def _p2p_key(self, src: int, dst: int, tag: int, seq: int) -> bytes:
+        import hashlib
+
+        return hashlib.blake2b(
+            f"xla_p2p:{self.group_name}:{src}:{dst}:{tag}:{seq}".encode(),
+            digest_size=16).digest()
+
+    _P2P_WINDOW = 8  # bounded in-flight sends per (dst, tag)
+
+    def send(self, tensor, dst_rank: int, tag: int = 0,
+             timeout: float = 300.0):
+        import time as _time
+
+        import jax.numpy as jnp
+
+        import ray_tpu
+
+        st = self._p2p_state()
+        k = (dst_rank, tag)
+        st["send_seq"][k] = seq = st["send_seq"].get(k, 0) + 1
+        key = self._p2p_key(self.rank, dst_rank, tag, seq)
+        # backpressure: the receiver frees each slot as it consumes it —
+        # block while the message WINDOW sends back is still unconsumed
+        old_key = (self._p2p_key(self.rank, dst_rank, tag,
+                                 seq - self._P2P_WINDOW)
+                   if seq > self._P2P_WINDOW else None)
+        deadline = _time.monotonic() + timeout
+        while old_key is not None and old_key in st["worker"].device_store:
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"send window to rank {dst_rank} full for {timeout}s")
+            _time.sleep(0.002)
+        # stays device-resident here until the receiver pulls + frees it
+        st["worker"].device_store[key] = jnp.asarray(tensor)
+        st.setdefault("sent_keys", set()).add(key)
+        # rendezvous flag: the receiver blocks on this instead of hammering
+        # our worker with GetDeviceObject polls
+        ray_tpu.get(st["store"].put_p2p.remote(key.hex(), True),
+                    timeout=timeout)
+
+    def recv(self, src_rank: int, tag: int = 0, timeout: float = 300.0):
+        import pickle as _pickle
+
+        import jax.numpy as jnp
+
+        import ray_tpu
+        from ray_tpu._private.object_store import read_blob
+        from ray_tpu._private.serialization import deserialize
+
+        st = self._p2p_state()
+        addr = st["addrs"].get(src_rank)
+        if addr is None:
+            addr = ray_tpu.get(st["store"].peek.remote(
+                f"addr:{self.group_name}:{src_rank}"), timeout=timeout)
+            st["addrs"][src_rank] = addr
+        k = (src_rank, tag)
+        st["recv_seq"][k] = seq = st["recv_seq"].get(k, 0) + 1
+        key = self._p2p_key(src_rank, self.rank, tag, seq)
+        # wait for the sender's ready flag (one blocking store call),
+        # then pull the tensor with a single direct worker RPC
+        ray_tpu.get(st["store"].get_p2p.remote(key.hex(), timeout),
+                    timeout=timeout + 10)
+        w = st["worker"]
+        client = w._worker_client(addr)
+        reply = _pickle.loads(w._run(client.call(
+            "GetDeviceObject", _pickle.dumps({"oid": key}),
+            timeout=60.0, retries=1), 70.0))
+        if reply["status"] != "ok":
+            raise RuntimeError(
+                f"p2p message from rank {src_rank} tag {tag} vanished "
+                f"(sender restarted?)")
+        # consume-once: release the sender's device-store slot
+        w._run(client.call("FreeDeviceObject",
+                           _pickle.dumps({"oid": key}), timeout=10.0,
+                           retries=1), 20.0)
+        inband, buffers = read_blob(reply["blob"])
+        return jnp.asarray(deserialize(inband, buffers))
 
     def destroy(self):
         self._cache.clear()
+        st = getattr(self, "_p2p", None)
+        if st is not None:
+            import ray_tpu
+
+            # unconsumed sends would otherwise pin device memory for the
+            # worker's lifetime; the store's addr key must go too or a
+            # re-created group would peek a stale address
+            for key in st.get("sent_keys", ()):
+                st["worker"].device_store.pop(key, None)
+            try:
+                ray_tpu.get(st["store"].del_p2p.remote(
+                    f"addr:{self.group_name}:{self.rank}"), timeout=10)
+            except Exception:
+                pass
+            self._p2p = None

@@ -307,26 +307,99 @@ class CompiledDAG:
                 actor = self._actor_of(n)
                 sched = sched_for(actor)
                 sched["ops"][sched["node_idx"][id(n)]]["out"] = cname
-        # allocate channels (driver creates; actors attach)
-        self._channels: List[Channel] = []
+        # channel TYPE per edge: same-node parties share a /dev/shm seqlock
+        # slot; any cross-node reader switches the channel to the
+        # cross-host mailbox tier (reference: shared-memory channels vs the
+        # cross-node mutable-object provider). Party placement comes from
+        # the GCS actor directory; the driver is its own party.
+        import ray_tpu as _rt
+
+        w = _rt._private.worker.global_worker()
+        driver_node, driver_addr = w.node_hex, w.address
+        placements: Dict[Any, tuple] = {}  # schedule-key -> (node, addr)
+        # actors may still be starting at compile time: wait until the GCS
+        # has a live placement for each — CONCURRENTLY, so a cold cluster
+        # costs max(actor ready time), not the sum
+        async def _all_ready():
+            import asyncio as _aio
+
+            scheds = list(schedules.values())
+            infos = await _aio.gather(*[
+                w._gcs_call("WaitActorReady", {
+                    "actor_id": s["actor"].actor_id.binary(),
+                    "timeout": 120.0}, timeout=130.0)
+                for s in scheds])
+            return {id(s): r["info"] for s, r in zip(scheds, infos)}
+
+        for sid, info in w._run(_all_ready(), 140.0).items():
+            placements[sid] = ((info or {}).get("node_id", ""),
+                               (info or {}).get("address", ""))
+
+        def party_place(party):
+            if party == "driver":
+                return driver_node, driver_addr
+            return placements[id(party)]
+
+        writer_of: Dict[str, Any] = {self._input_chan_name: "driver"}
+        for n in method_nodes:
+            writer_of[chan_of[id(n)]] = sched_for(self._actor_of(n))
+
+        self._chan_specs: Dict[str, dict] = {}
+        for cname, readers in readers_of.items():
+            if cname != self._input_chan_name and not readers:
+                continue
+            wnode, _ = party_place(writer_of[cname])
+            if any(party_place(p)[0] != wnode or not party_place(p)[0]
+                   for p in readers):
+                self._chan_specs[cname] = {"type": "xhost"}
+
+        # allocate channels (driver creates shm ones; actors attach).
+        # Cross-host channels have no shared segment: each reader owns a
+        # mailbox named <chan>@<slot> at its worker; the writer pushes to
+        # every mailbox.
+        self._channels: List[Any] = []
         self._driver_slots: Dict[str, int] = {}
         for cname, readers in readers_of.items():
             if cname != self._input_chan_name and not readers:
                 continue  # unconsumed intermediate: no channel needed
+            spec = self._chan_specs.get(cname)
             num = max(1, len(readers))
-            ch = Channel(cname, create=True, num_readers=num)
-            self._channels.append(ch)
+            if spec is None:
+                self._channels.append(Channel(cname, create=True,
+                                              num_readers=num))
+            else:
+                spec["push"] = []
             for slot, party in enumerate(readers):
                 if party == "driver":
                     self._driver_slots[cname] = slot
                 else:
                     party["chan_readers"][cname] = slot
-        self._in_chan = next(
-            c for c in self._channels if c.name.endswith("_in"))
-        self._out_chans: Dict[str, Channel] = {}
+                if spec is not None:
+                    spec["push"].append(
+                        (f"{cname}@{slot}", party_place(party)[1]))
+        for sched in schedules.values():
+            sched["chan_specs"] = {
+                c: {"type": "xhost", "push": self._chan_specs[c]["push"]}
+                for c in set(list(sched["chan_readers"]) +
+                             [op["out"] for op in sched["ops"] if op["out"]])
+                if c in self._chan_specs}
+
+        from ray_tpu.dag.channels import open_reader, open_writer
+
+        in_spec = self._chan_specs.get(self._input_chan_name)
+        if in_spec is None:
+            self._in_chan = next(
+                c for c in self._channels
+                if getattr(c, "name", "").endswith("_in"))
+        else:
+            self._in_chan = open_writer(self._input_chan_name, in_spec)
+            self._channels.append(self._in_chan)
+        self._out_chans: Dict[str, Any] = {}
         for cname in self._out_chans_names:
-            self._out_chans[cname] = Channel(
-                cname, reader_slot=self._driver_slots[cname])
+            self._out_chans[cname] = open_reader(
+                cname, self._driver_slots[cname], self._chan_specs.get(cname))
+            if self._chan_specs.get(cname) is not None:
+                self._channels.append(self._out_chans[cname])
         self._schedules = list(schedules.values())
         # the input channel is fed from a dedicated thread so execute() never
         # blocks the driver when the pipeline is full (the driver must stay
@@ -360,6 +433,7 @@ class CompiledDAG:
         for sched in self._schedules:
             actor = sched["actor"]
             payload = {"chan_readers": sched["chan_readers"],
+                       "chan_specs": sched.get("chan_specs", {}),
                        "ops": sched["ops"]}
             refs.append(ActorMethod(actor, DAG_LOOP_METHOD).remote(payload))
         for r in refs:

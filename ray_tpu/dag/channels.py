@@ -168,3 +168,73 @@ class IntraProcessChannel:
 
     def close(self, unlink: bool = False):
         pass
+
+
+# ---------------------------------------------------------------------------
+# cross-host channels (reference: the cross-node leg of compiled-graph
+# channels, experimental_mutable_object_provider.cc — a writer pushes each
+# version to a reader-hosted mailbox; the awaited push is the backpressure)
+# ---------------------------------------------------------------------------
+
+
+class CrossHostWriter:
+    """Single writer pushing every value to each reader's worker mailbox
+    over the worker RPC plane (out-of-band buffers ride zero-copy frames)."""
+
+    def __init__(self, name: str, push_targets):
+        from ray_tpu._private import worker as worker_mod
+
+        self.name = name
+        self._targets = list(push_targets)  # [(mailbox_name, worker_addr)]
+        self._w = worker_mod.global_worker()
+
+    def write(self, value: Any, timeout: Optional[float] = 300.0):
+        import pickle as _p
+
+        blob = dumps_oob(value)
+        for mbox, addr in self._targets:
+            self._w._run(self._w._worker_client(addr).call(
+                "ChanPush", _p.dumps({"name": mbox, "blob": blob}),
+                timeout=timeout or 300.0, retries=0),
+                (timeout or 300.0) + 10.0)
+
+    def read(self, timeout: float = 300.0):
+        raise RuntimeError("cross-host channel writer cannot read")
+
+    def close(self, unlink: bool = False):
+        pass
+
+
+class CrossHostReader:
+    """Reader end: pops from THIS worker's mailbox (values were pushed by
+    the remote writer)."""
+
+    def __init__(self, mailbox: str):
+        from ray_tpu._private import worker as worker_mod
+
+        self.name = mailbox
+        self._w = worker_mod.global_worker()
+
+    def read(self, timeout: float = 300.0) -> Any:
+        return loads_oob(self._w.chan_pop(self.name, timeout))
+
+    def write(self, value, timeout=None):
+        raise RuntimeError("cross-host channel reader cannot write")
+
+    def close(self, unlink: bool = False):
+        if unlink:
+            self._w.chan_close(self.name)
+
+
+def open_reader(name: str, slot: int, spec: Optional[dict] = None):
+    """Channel factory, reader side: shm seqlock slot (same-node) or the
+    per-reader cross-host mailbox."""
+    if spec and spec.get("type") == "xhost":
+        return CrossHostReader(f"{name}@{slot}")
+    return Channel(name, reader_slot=slot)
+
+
+def open_writer(name: str, spec: Optional[dict] = None):
+    if spec and spec.get("type") == "xhost":
+        return CrossHostWriter(name, spec["push"])
+    return Channel(name)

@@ -40,15 +40,19 @@ class DagLoopRunner:
     """Runs one actor's static schedule until a STOP sentinel arrives."""
 
     def __init__(self, instance: Any, schedule: dict):
+        from ray_tpu.dag.channels import open_reader, open_writer
+
         self.instance = instance
         self.ops: List[dict] = schedule["ops"]
-        self._read_chans: Dict[str, Channel] = {}
-        self._write_chans: Dict[str, Channel] = {}
+        specs = schedule.get("chan_specs") or {}
+        self._read_chans: Dict[str, Any] = {}
+        self._write_chans: Dict[str, Any] = {}
         for name, slot in (schedule.get("chan_readers") or {}).items():
-            self._read_chans[name] = Channel(name, reader_slot=slot)
+            self._read_chans[name] = open_reader(name, slot, specs.get(name))
         for op in self.ops:
             if op.get("out"):
-                self._write_chans[op["out"]] = Channel(op["out"])
+                self._write_chans[op["out"]] = open_writer(
+                    op["out"], specs.get(op["out"]))
         self._thread: Optional[threading.Thread] = None
 
     def start(self):

@@ -42,7 +42,13 @@ class PrefillWorker:
 
         self.engine = JaxLLMEngine(config, params=_load_params_blob(params_blob))
 
+    @ray_tpu.method(tensor_transport="device")
     def prefill(self, prompt: Any, params: Optional[SamplingParams] = None) -> dict:
+        # tensor_transport="device": the KV state STAYS resident in this
+        # worker; the reply is a small marker, and the decode worker pulls
+        # the state DIRECTLY from here (producer->consumer p2p over the
+        # device-object plane — the router never touches the KV bytes;
+        # reference: the KV-transfer connectors of pd_server.py)
         rid = uuid.uuid4().hex
         return self.engine.prefill_only(rid, prompt, params)
 
@@ -109,9 +115,11 @@ class PDServer:
                           top_p: float = 1.0) -> dict:
         params = SamplingParams(max_tokens=max_tokens, temperature=temperature,
                                 top_k=top_k, top_p=top_p)
-        state = await self._pick(self.prefill_workers).prefill.remote(
+        # hand the REF (not the value) to decode: the KV rides the device
+        # plane prefill-worker -> decode-worker, never through this router
+        state_ref = self._pick(self.prefill_workers).prefill.remote(
             prompt, params)
-        return await self._pick(self.decode_workers).decode.remote(state)
+        return await self._pick(self.decode_workers).decode.remote(state_ref)
 
     async def __call__(self, body: dict) -> dict:
         kw = {k: body[k] for k in ("max_tokens", "temperature", "top_k", "top_p")
