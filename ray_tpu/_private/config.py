@@ -92,6 +92,8 @@ _d("worker_start_timeout_s", 60.0)
 # before failing with a scheduling error
 _d("infeasible_task_timeout_s", 300.0)
 
+_d("object_pull_concurrency", 8)  # concurrent inbound transfers per node
+
 # --- OOM defense (reference: memory_monitor.h:52) ---
 _d("memory_usage_threshold", 0.95)
 _d("memory_monitor_refresh_ms", 500)

@@ -1006,27 +1006,58 @@ class CoreWorker:
         return values[0] if single else values
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
-        async def _ready(ref) -> bool:
-            oid = ref.id
-            if oid in self.memory_store or self._in_store.get(oid):
-                return True
-            fut = self._result_futures.get(oid)
-            if fut is not None:
-                return fut.done()
-            reply = pickle.loads(await self.raylet.call("StoreContains", pickle.dumps(
-                {"oid": oid.binary()})))
-            return reply["contains"]
+        """Event-driven wait (reference: raylet/wait_manager.h): locally-
+        owned pending refs ride their result futures; store-resident refs
+        ride ONE StoreWaitAny long-poll at the raylet (which hooks the
+        store's seal events) — no per-ref per-tick RPC fan-out."""
 
         async def _wait():
-            deadline = time.monotonic() + (timeout if timeout is not None else 86400.0)
+            deadline = time.monotonic() + (timeout if timeout is not None
+                                           else 86400.0)
             while True:
-                flags = await asyncio.gather(*[_ready(r) for r in refs])
-                ready = [r for r, f in zip(refs, flags) if f]
+                ready, fut_pending, store_pending = [], [], []
+                for r in refs:
+                    oid = r.id
+                    if oid in self.memory_store or self._in_store.get(oid):
+                        ready.append(r)
+                        continue
+                    fut = self._result_futures.get(oid)
+                    if fut is None:
+                        store_pending.append(r)
+                    elif fut.done():
+                        ready.append(r)
+                    else:
+                        fut_pending.append(fut)
                 if len(ready) >= num_returns or time.monotonic() >= deadline:
                     ready = ready[:num_returns]
-                    rest = [r for r in refs if r not in ready]
-                    return ready, rest
-                await asyncio.sleep(0.01)
+                    return ready, [r for r in refs if r not in ready]
+                chunk = max(0.05, min(10.0, deadline - time.monotonic()))
+                waiters = []
+                if fut_pending:
+                    waiters.append(asyncio.ensure_future(asyncio.wait(
+                        fut_pending, return_when=asyncio.FIRST_COMPLETED)))
+                if store_pending:
+                    waiters.append(asyncio.ensure_future(self.raylet.call(
+                        "StoreWaitAny", pickle.dumps({
+                            "oids": [r.binary() for r in store_pending],
+                            "num_needed": 1, "timeout": chunk}),
+                        timeout=chunk + 10.0, retries=0)))
+                if not waiters:
+                    await asyncio.sleep(0.01)
+                    continue
+                done, pend = await asyncio.wait(
+                    waiters, return_when=asyncio.FIRST_COMPLETED,
+                    timeout=chunk)
+                for t in pend:
+                    t.cancel()
+                failed = False
+                for t in done:
+                    # retrieve exceptions (a StoreWaitAny to a restarting
+                    # raylet fails) — unretrieved task errors spam logs
+                    if not t.cancelled() and t.exception() is not None:
+                        failed = True
+                if failed:
+                    await asyncio.sleep(0.2)  # backoff, don't churn RPCs
 
         return self._run(_wait())
 
@@ -2515,16 +2546,30 @@ class CoreWorker:
 
     async def _wait_for_turn(self, spec: TaskSpec):
         """Per-caller seqno ordering (reference: actor_scheduling_queue.cc):
-        start tasks in submission order; a missing seqno (failed send) only
-        stalls successors for a bounded grace period."""
-        state = self._order_buf.setdefault(spec.owner_address, {"expected": 1, "events": {}})
+        start tasks in submission order. A missing seqno (failed send)
+        stalls successors only for a bounded grace period, after which the
+        gap is ABANDONED: a predecessor arriving later is rejected as
+        stale (the owner retries it under a fresh seqno) rather than
+        silently executed out of order."""
+        from ray_tpu.exceptions import TaskError as _TaskError
+
+        state = self._order_buf.setdefault(
+            spec.owner_address, {"expected": 1, "events": {}})
+        if spec.seqno < state["expected"]:
+            raise _TaskError(
+                f"stale actor-task seqno {spec.seqno} (queue already at "
+                f"{state['expected']}): an abandoned ordering gap — "
+                f"resubmit under a fresh seqno", "")
         if spec.seqno > state["expected"]:
             ev = state["events"].setdefault(spec.seqno, asyncio.Event())
             try:
-                # bounded grace: a gap (lost predecessor) must not wedge the queue
-                await asyncio.wait_for(ev.wait(), timeout=10.0)
+                # bounded grace: a gap (lost predecessor) must not wedge
+                # the queue forever
+                await asyncio.wait_for(ev.wait(), timeout=30.0)
             except asyncio.TimeoutError:
-                pass
+                logger.warning(
+                    "actor queue abandoning ordering gap before seqno %d "
+                    "(predecessor lost?)", spec.seqno)
         state["expected"] = max(state["expected"], spec.seqno + 1)
         nxt = state["events"].pop(state["expected"], None)
         if nxt is not None:

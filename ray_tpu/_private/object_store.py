@@ -449,6 +449,17 @@ class ObjectStoreServer:
             return True
         except asyncio.TimeoutError:
             return False
+        finally:
+            # cancelled/timed-out waiters must not pile up on oids that
+            # never seal (StoreWaitAny cancels these every chunk)
+            lst = self.waiters.get(oid)
+            if lst is not None:
+                try:
+                    lst.remove(fut)
+                except ValueError:
+                    pass
+                if not lst:
+                    self.waiters.pop(oid, None)
 
     def access(self, oid: bytes) -> dict:
         """Local read: returns shm name (restoring from spill) or inline blob."""

@@ -58,6 +58,10 @@ def _preexec():
         pass
 
 
+class _PullRetry(Exception):
+    """Internal: the chosen pull source had no usable copy; re-pick."""
+
+
 class WorkerProc:
     def __init__(self, proc: subprocess.Popen, renv_hash: str = ""):
         self.proc = proc
@@ -123,6 +127,10 @@ class Raylet:
         self._spawn_env = dict(os.environ)
         self._spawn_sem = asyncio.Semaphore(
             max(1, RAY_CONFIG.worker_startup_concurrency))
+        # bounded concurrent inbound pulls (reference: pull_manager.cc's
+        # prioritized admission; FIFO here — all pulls are one class)
+        self._pull_sem = asyncio.Semaphore(
+            max(1, RAY_CONFIG.object_pull_concurrency))
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -579,6 +587,31 @@ class Raylet:
         self._release_lease(req["lease_id"])
         return {"status": "ok"}
 
+    async def _rpc_StoreWaitAny(self, req, conn):
+        """Event-driven wait leg (reference: raylet/wait_manager.h): parks
+        on the store's seal events until >= num_needed of the oids are
+        local (or the bounded chunk expires); one RPC replaces the owner's
+        per-ref per-tick StoreContains fan-out."""
+        oids = req["oids"]
+        need = max(1, req.get("num_needed", 1))
+        deadline = time.monotonic() + min(req.get("timeout", 10.0), 30.0)
+        while True:
+            present = [o for o in oids if self.store.contains(o)]
+            remaining = deadline - time.monotonic()
+            if len(present) >= need or remaining <= 0:
+                return {"present": present}
+            present_set = set(present)
+            absent = [o for o in oids if o not in present_set]
+            tasks = [asyncio.ensure_future(
+                self.store.wait_local(o, remaining)) for o in absent]
+            try:
+                await asyncio.wait(tasks,
+                                   return_when=asyncio.FIRST_COMPLETED,
+                                   timeout=remaining)
+            finally:
+                for t in tasks:
+                    t.cancel()
+
     async def _rpc_WasWorkerOOM(self, req, conn):
         # owners ask after a push failure whether the memory monitor killed
         # the worker, to surface OutOfMemoryError instead of a generic death
@@ -725,7 +758,18 @@ class Raylet:
 
     async def _pull(self, oid: bytes):
         """Chunked transfer from a remote node's store (reference:
-        object_manager/pull_manager.cc + push_manager.cc)."""
+        object_manager/pull_manager.cc + push_manager.cc). Bounded
+        concurrency (FIFO through a semaphore) keeps a burst of pulls from
+        monopolizing the loop and network, and the SOURCE is chosen at
+        random among announced holders: since every completed pull
+        announces a new location, an N-node broadcast forms an organic
+        fan-out tree off the origin instead of an N-deep queue on it
+        (reference: the 1 GiB / 50-node broadcast envelope)."""
+        await self._pull_inner(oid)
+
+    async def _pull_inner(self, oid: bytes):
+        import random as _random
+
         deadline = time.monotonic() + RAY_CONFIG.object_pull_timeout_s
         chunk = RAY_CONFIG.object_chunk_bytes
         while time.monotonic() < deadline:
@@ -741,51 +785,66 @@ class Raylet:
             if not locations:
                 await asyncio.sleep(0.1)
                 continue
+            locations[0] = _random.choice(locations)
             src = RetryingRpcClient(locations[0]["address"])
+            attempt = None  # set once meta arrives; guards the except path
             try:
-                meta = pickle.loads(await src.call("StoreMeta", pickle.dumps({"oid": oid})))
-                size = meta.get("size")
-                if size is None:
-                    await asyncio.sleep(0.1)
-                    continue
-                attempt = meta.get("attempt", 0)
-                created = self.store.create(oid, size, attempt)
-                if created["status"] in ("exists", "stale_attempt"):
-                    return
-                if created["status"] != "ok":
-                    logger.warning("pull %s: local store oom", oid.hex()[:12])
-                    return
-                offset = 0
-                while offset < size:
-                    n = min(chunk, size - offset)
-                    r = pickle.loads(await src.call("StoreFetchChunk", pickle.dumps(
-                        {"oid": oid, "offset": offset, "length": n,
-                         "attempt": attempt})))
-                    data = r.get("data")
-                    if data is None:
-                        raise RpcError("source evicted or displaced object mid-pull")
-                    try:
-                        self.store.write_chunk(oid, offset, data, attempt)
-                    except KeyError:
-                        # displaced locally by a newer attempt: clean abort —
-                        # the newer copy is (or will be) the committed one
+                # the concurrency bound covers only the actual TRANSFER:
+                # a slot must not be parked on location polling for an
+                # object nobody has announced yet
+                async with self._pull_sem:
+                    if self.store.contains(oid):
                         return
-                    offset += n
-                if self.store.seal(oid, attempt):
-                    await self._announce([oid], attempt)
+                    await self._pull_transfer(oid, src, chunk)
                 return
+            except _PullRetry:
+                await asyncio.sleep(0.1)
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 logger.warning("pull %s from %s failed: %s", oid.hex()[:12],
                                locations[0]["address"], e)
-                # only clean up OUR partial copy — a newer attempt may have
-                # displaced the entry mid-transfer and must not be deleted
-                if self.store.object_attempt(oid) == attempt \
-                        and not self.store.contains(oid):
-                    self.store.delete([oid])
                 await asyncio.sleep(0.2)
             finally:
                 await src.close()
         logger.warning("pull %s timed out", oid.hex()[:12])
+
+    async def _pull_transfer(self, oid: bytes, src, chunk: int):
+        meta = pickle.loads(await src.call("StoreMeta", pickle.dumps({"oid": oid})))
+        size = meta.get("size")
+        if size is None:
+            raise _PullRetry()
+        attempt = meta.get("attempt", 0)
+        created = self.store.create(oid, size, attempt)
+        if created["status"] in ("exists", "stale_attempt"):
+            return
+        if created["status"] != "ok":
+            logger.warning("pull %s: local store oom", oid.hex()[:12])
+            return
+        try:
+            offset = 0
+            while offset < size:
+                n = min(chunk, size - offset)
+                r = pickle.loads(await src.call("StoreFetchChunk", pickle.dumps(
+                    {"oid": oid, "offset": offset, "length": n,
+                     "attempt": attempt})))
+                data = r.get("data")
+                if data is None:
+                    raise RpcError("source evicted or displaced object mid-pull")
+                try:
+                    self.store.write_chunk(oid, offset, data, attempt)
+                except KeyError:
+                    # displaced locally by a newer attempt: clean abort —
+                    # the newer copy is (or will be) the committed one
+                    return
+                offset += n
+            if self.store.seal(oid, attempt):
+                await self._announce([oid], attempt)
+        except (RpcError, asyncio.TimeoutError, OSError):
+            # only clean up OUR partial copy — a newer attempt may have
+            # displaced the entry mid-transfer and must not be deleted
+            if self.store.object_attempt(oid) == attempt \
+                    and not self.store.contains(oid):
+                self.store.delete([oid])
+            raise
 
     # ------------------------------------------------------------------
 
