@@ -21,6 +21,7 @@ import asyncio
 import logging
 import pickle
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.common import (
@@ -158,6 +159,9 @@ class GcsServer:
         self.pending_demands: Dict[tuple, dict] = {}
         self.node_last_used: Dict[NodeID, float] = {}
         self.node_num_leases: Dict[NodeID, int] = {}
+        # structured event ring (reference: util/event.cc + export events
+        # aggregated by the dashboard) — bounded, newest at the right
+        self.events = deque(maxlen=1000)
         self._background: List[asyncio.Task] = []
         self.start_time = time.time()
         self._load_init_data()
@@ -301,6 +305,9 @@ class GcsServer:
                     info.total_resources, info.labels)
         self._publish("nodes", {"event": "added", "node": info.to_dict()})
         self._publish("resource_view", self._view_entry(info.node_id))
+        self._record_event("node", "INFO", "node registered",
+                           node_id=info.node_id.hex(),
+                           resources=dict(info.total_resources))
         return {"status": "ok"}
 
     async def _rpc_Heartbeat(self, req, conn):
@@ -380,6 +387,8 @@ class GcsServer:
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish("nodes", {"event": "removed", "node_id": node_id.hex(), "reason": reason})
         self._publish("resource_view", self._view_entry(node_id))
+        self._record_event("node", "ERROR", f"node dead: {reason}",
+                           node_id=node_id.hex())
         # drop object locations on that node; keep the committed-attempt
         # tombstone so a partitioned zombie's stale announce can't
         # re-register an older epoch as current
@@ -488,6 +497,28 @@ class GcsServer:
     # ------------------------------------------------------------------
     # pubsub
     # ------------------------------------------------------------------
+
+    def _record_event(self, source: str, severity: str, message: str,
+                      **metadata):
+        event = {"ts": time.time(), "source": source, "severity": severity,
+                 "message": message, "metadata": metadata}
+        self.events.append(event)
+        self._publish("events", event)
+
+    async def _rpc_ReportEvent(self, req, conn):
+        ev = dict(req["event"])
+        self.events.append(ev)
+        self._publish("events", ev)
+        return {"status": "ok"}
+
+    async def _rpc_GetEvents(self, req, conn):
+        out = list(self.events)
+        if req.get("source"):
+            out = [e for e in out if e.get("source") == req["source"]]
+        if req.get("severity"):
+            want = str(req["severity"]).upper()
+            out = [e for e in out if e.get("severity") == want]
+        return {"events": out[-int(req.get("limit") or 200):]}
 
     async def _rpc_Subscribe(self, req, conn):
         channels = set(req["channels"])
@@ -854,9 +885,16 @@ class GcsServer:
             record.state = "DEAD"
             record.death_cause = reason
             self._publish_actor(record)
+            self._record_event("actor", "ERROR", f"actor dead: {reason}",
+                               actor_id=record.actor_id.hex(),
+                               class_name=record.class_name)
             return
         record.restarts_used += 1
         record.state = "RESTARTING"
+        self._record_event("actor", "WARNING",
+                           f"actor restarting ({reason})",
+                           actor_id=record.actor_id.hex(),
+                           restarts_used=record.restarts_used)
         record.address = ""
         record.node_id = None
         self._publish_actor(record)
@@ -918,6 +956,9 @@ class GcsServer:
                 if self.named_actors[(record.namespace, record.name)] == record.actor_id:
                     del self.named_actors[(record.namespace, record.name)]
             self._publish_actor(record)
+            self._record_event("actor", "INFO", f"actor killed: {reason}",
+                               actor_id=record.actor_id.hex(),
+                               class_name=record.class_name)
 
     async def _rpc_WorkerDied(self, req, conn):
         """Raylet tells us a worker process exited (reference: raylet→GCS
@@ -925,9 +966,14 @@ class GcsServer:
         address = req["worker_address"]
         self._publish("workers", {"event": "died", "worker_address": address,
                                   "node_id": req.get("node_id")})
+        reason = req.get("reason", "worker died")
+        self._record_event(
+            "worker", "ERROR" if "OOM" in reason else "WARNING",
+            f"worker died: {reason}", worker_address=address,
+            node_id=req.get("node_id"))
         for record in self.actors.values():
             if record.address == address and record.state == "ALIVE":
-                await self._on_actor_worker_lost(record, req.get("reason", "worker died"))
+                await self._on_actor_worker_lost(record, reason)
         return {"status": "ok"}
 
     # ------------------------------------------------------------------

@@ -127,6 +127,23 @@ def cmd_timeline(args):
     print(f"wrote {n} events to {args.out} (open in chrome://tracing)")
 
 
+def cmd_events(args):
+    """ray-tpu events: recent structured cluster events (reference: the
+    export-event pipeline surfaced by the dashboard aggregator)."""
+    _connect(args)
+    import time as _t
+
+    from ray_tpu.util import events as events_mod
+
+    for e in events_mod.list_events(source=args.source or None,
+                                    severity=args.severity or None,
+                                    limit=args.limit):
+        ts = _t.strftime("%H:%M:%S", _t.localtime(e.get("ts", 0)))
+        meta = " ".join(f"{k}={v}" for k, v in (e.get("metadata") or {}).items())
+        print(f"{ts} [{e.get('severity')}] {e.get('source')}: "
+              f"{e.get('message')} {meta}")
+
+
 def cmd_microbenchmark(args):
     import ray_tpu
 
@@ -180,6 +197,12 @@ def main(argv=None):
     p = sub.add_parser("timeline", help="export chrome://tracing task timeline")
     p.add_argument("--out", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("events", help="recent structured cluster events")
+    p.add_argument("--source", default="")
+    p.add_argument("--severity", default="")
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser("microbenchmark", help="run the core perf suite")
     p.add_argument("--duration", type=float, default=2.0)

@@ -1,0 +1,75 @@
+"""Structured cluster events (reference: src/ray/util/event.cc + the
+export-event pipeline src/ray/protobuf/public/events_*.proto -> dashboard
+aggregator): system components report typed events (node/actor/worker
+lifecycle, OOM kills) to the GCS, which keeps a bounded ring and publishes
+them on the ``events`` pubsub channel; ``ray-tpu events`` and
+``list_events()`` read them back."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR", "FATAL")
+
+# local-mode fallback ring (mirrors tracing.py's local tier)
+_local_events: List[Dict[str, Any]] = []
+
+
+def _on_worker_loop(core) -> bool:
+    try:
+        import asyncio
+
+        return asyncio.get_running_loop() is core.loop
+    except RuntimeError:
+        return False
+
+
+def record(source: str, severity: str, message: str, **metadata) -> None:
+    """Report one structured event to the GCS (best-effort, never raises).
+    Safe from any context: driver threads, sync tasks, and async actor
+    methods (which run ON the worker's io loop — those fire and forget)."""
+    from ray_tpu._private.worker import global_worker, is_initialized
+
+    severity = severity.upper()
+    if severity not in SEVERITIES:
+        severity = "INFO"
+    event = {"ts": time.time(), "source": source, "severity": severity,
+             "message": message, "metadata": metadata}
+    try:
+        if not is_initialized():
+            _local_events.append(event)
+            return
+        core = global_worker()
+        if getattr(core, "mode", "") == "local":
+            _local_events.append(event)
+            return
+        coro = core._gcs_call("ReportEvent", {"event": event})
+        if _on_worker_loop(core):
+            import asyncio
+
+            asyncio.ensure_future(coro)
+        else:
+            core._run(coro, 10.0)
+    except Exception:
+        pass
+
+
+def list_events(source: Optional[str] = None,
+                severity: Optional[str] = None,
+                limit: int = 200) -> List[Dict[str, Any]]:
+    """Recent cluster events, newest last."""
+    from ray_tpu._private.worker import global_worker
+
+    severity = severity.upper() if severity else None
+    core = global_worker()
+    if getattr(core, "mode", "") == "local" or not hasattr(core, "_gcs_call"):
+        out = list(_local_events)
+        if source:
+            out = [e for e in out if e.get("source") == source]
+        if severity:
+            out = [e for e in out if e.get("severity") == severity]
+        return out[-limit:]
+    out = core._run(core._gcs_call("GetEvents", {
+        "source": source, "severity": severity, "limit": limit}), 30.0)
+    return out["events"]

@@ -1,0 +1,193 @@
+// Native unit tests for the arena object store, driven through the same C
+// ABI the Python binding uses (reference: the gtest tier colocated with
+// src/ray/object_manager/plasma/tests — here assert-based so the only
+// dependency is g++). Built and executed by tests/test_native_store.py.
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+extern "C" {
+void* rts_open(const char* path, uint64_t capacity, int create);
+void rts_close(void* handle);
+int rts_alloc(void* handle, const uint8_t* oid, uint64_t size, uint64_t* offset_out);
+int rts_seal(void* handle, const uint8_t* oid);
+int rts_lookup(void* handle, const uint8_t* oid, uint64_t* offset, uint64_t* size,
+               int* sealed);
+int rts_free(void* handle, const uint8_t* oid);
+uint64_t rts_used(void* handle);
+uint64_t rts_capacity(void* handle);
+uint64_t rts_num_objects(void* handle);
+uint64_t rts_largest_free(void* handle);
+int rts_read(void* handle, uint64_t offset, uint64_t length, uint8_t* out);
+int rts_write(void* handle, uint64_t offset, const uint8_t* data, uint64_t length);
+}
+
+namespace {
+
+void MakeId(uint8_t* out, int n) {
+  std::memset(out, 0, 16);
+  std::memcpy(out, &n, sizeof(n));
+}
+
+int tests_run = 0;
+#define CHECK(cond)                                                         \
+  do {                                                                      \
+    if (!(cond)) {                                                          \
+      std::fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                             \
+    }                                                                       \
+  } while (0)
+#define RUN(fn)                          \
+  do {                                   \
+    if (fn(path)) return 1;              \
+    ++tests_run;                         \
+  } while (0)
+
+int TestAllocSealLookupFree(const std::string& base) {
+  std::string path = base + ".a";
+  void* s = rts_open(path.c_str(), 1 << 20, 1);
+  CHECK(s != nullptr);
+  CHECK(rts_capacity(s) == (1u << 20));
+  CHECK(rts_used(s) == 0);
+
+  uint8_t id[16];
+  MakeId(id, 1);
+  uint64_t off = 0;
+  CHECK(rts_alloc(s, id, 1000, &off) == 0);
+  CHECK(off % 64 == 0);  // 64-byte aligned for zero-copy numpy/jax maps
+  CHECK(rts_num_objects(s) == 1);
+
+  uint64_t loff = 0, lsize = 0;
+  int sealed = -1;
+  CHECK(rts_lookup(s, id, &loff, &lsize, &sealed) == 0);
+  CHECK(loff == off && lsize == 1000 && sealed == 0);
+
+  const char payload[] = "arena-store-native-test";
+  CHECK(rts_write(s, off, reinterpret_cast<const uint8_t*>(payload),
+                  sizeof(payload)) == 0);
+  CHECK(rts_seal(s, id) == 0);
+  CHECK(rts_lookup(s, id, &loff, &lsize, &sealed) == 0);
+  CHECK(sealed == 1);
+  uint8_t back[sizeof(payload)] = {0};
+  CHECK(rts_read(s, off, sizeof(payload), back) == 0);
+  CHECK(std::memcmp(back, payload, sizeof(payload)) == 0);
+
+  CHECK(rts_free(s, id) == 0);
+  CHECK(rts_num_objects(s) == 0);
+  CHECK(rts_used(s) == 0);
+  rts_close(s);
+  return 0;
+}
+
+int TestDuplicateAndMissing(const std::string& base) {
+  std::string path = base + ".b";
+  void* s = rts_open(path.c_str(), 1 << 20, 1);
+  CHECK(s != nullptr);
+  uint8_t id[16];
+  MakeId(id, 7);
+  uint64_t off = 0;
+  CHECK(rts_alloc(s, id, 128, &off) == 0);
+  // duplicate key must be rejected, not silently re-allocated
+  CHECK(rts_alloc(s, id, 128, &off) != 0);
+  uint8_t missing[16];
+  MakeId(missing, 999);
+  uint64_t o, sz;
+  int sealed;
+  CHECK(rts_lookup(s, missing, &o, &sz, &sealed) != 0);
+  CHECK(rts_free(s, missing) != 0);
+  rts_close(s);
+  return 0;
+}
+
+int TestCoalescingRecoversLargestFree(const std::string& base) {
+  std::string path = base + ".c";
+  const uint64_t cap = 1 << 20;
+  void* s = rts_open(path.c_str(), cap, 1);
+  CHECK(s != nullptr);
+  const uint64_t initial_largest = rts_largest_free(s);
+  uint8_t ids[8][16];
+  uint64_t off;
+  for (int i = 0; i < 8; ++i) {
+    MakeId(ids[i], 100 + i);
+    CHECK(rts_alloc(s, ids[i], 32 * 1024, &off) == 0);
+  }
+  CHECK(rts_largest_free(s) < initial_largest);
+  // free every other block: largest free stays fragmented...
+  for (int i = 0; i < 8; i += 2) CHECK(rts_free(s, ids[i]) == 0);
+  uint64_t fragmented = rts_largest_free(s);
+  // ...then free the rest: neighbors must COALESCE back to one region
+  for (int i = 1; i < 8; i += 2) CHECK(rts_free(s, ids[i]) == 0);
+  CHECK(rts_largest_free(s) == initial_largest);
+  CHECK(fragmented < initial_largest);
+  rts_close(s);
+  return 0;
+}
+
+int TestOutOfMemory(const std::string& base) {
+  std::string path = base + ".d";
+  void* s = rts_open(path.c_str(), 64 * 1024, 1);
+  CHECK(s != nullptr);
+  uint8_t id[16], id2[16];
+  MakeId(id, 1);
+  MakeId(id2, 2);
+  uint64_t off;
+  CHECK(rts_alloc(s, id, 32 * 1024, &off) == 0);
+  // no contiguous room left for this one
+  CHECK(rts_alloc(s, id2, 48 * 1024, &off) != 0);
+  // freeing makes it fit again
+  CHECK(rts_free(s, id) == 0);
+  CHECK(rts_alloc(s, id2, 48 * 1024, &off) == 0);
+  rts_close(s);
+  return 0;
+}
+
+int TestReopenExisting(const std::string& base) {
+  std::string path = base + ".e";
+  void* s = rts_open(path.c_str(), 1 << 18, 1);
+  CHECK(s != nullptr);
+  uint8_t id[16];
+  MakeId(id, 42);
+  uint64_t off;
+  CHECK(rts_alloc(s, id, 4096, &off) == 0);
+  const char word[] = "persist";
+  CHECK(rts_write(s, off, reinterpret_cast<const uint8_t*>(word),
+                  sizeof(word)) == 0);
+  rts_close(s);
+  // a second mapping of the same file sees the same bytes (this is what
+  // client processes do: open create=0 and read sealed regions zero-copy)
+  void* s2 = rts_open(path.c_str(), 1 << 18, 0);
+  CHECK(s2 != nullptr);
+  uint8_t back[sizeof(word)] = {0};
+  CHECK(rts_read(s2, off, sizeof(word), back) == 0);
+  CHECK(std::memcmp(back, word, sizeof(word)) == 0);
+  rts_close(s2);
+  return 0;
+}
+
+int TestBoundsChecked(const std::string& base) {
+  std::string path = base + ".f";
+  void* s = rts_open(path.c_str(), 64 * 1024, 1);
+  CHECK(s != nullptr);
+  uint8_t buf[16] = {0};
+  CHECK(rts_read(s, 60 * 1024, 8 * 1024, buf) != 0);   // past capacity
+  CHECK(rts_write(s, 64 * 1024, buf, 1) != 0);
+  rts_close(s);
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string path = argc > 1 ? argv[1] : "/dev/shm/rtpu_native_test";
+  RUN(TestAllocSealLookupFree);
+  RUN(TestDuplicateAndMissing);
+  RUN(TestCoalescingRecoversLargestFree);
+  RUN(TestOutOfMemory);
+  RUN(TestReopenExisting);
+  RUN(TestBoundsChecked);
+  std::printf("OK %d native arena tests\n", tests_run);
+  return 0;
+}

@@ -1,0 +1,39 @@
+"""Native-tier unit tests: builds and runs the C++ arena-store test binary
+against the same C ABI the Python binding loads (reference: the gtest
+suites colocated with src/ray/object_manager/plasma)."""
+
+import os
+import subprocess
+import tempfile
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "src", "object_store")
+
+
+@pytest.fixture(scope="module")
+def test_binary(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("native") / "store_test")
+    build = subprocess.run(
+        ["g++", "-O1", "-std=c++17",
+         os.path.join(SRC, "store.cc"), os.path.join(SRC, "store_test.cc"),
+         "-o", out],
+        capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr
+    return out
+
+
+def test_arena_store_native_suite(test_binary, tmp_path):
+    base = os.path.join("/dev/shm", f"rtpu_ntest_{os.getpid()}")
+    try:
+        run = subprocess.run([test_binary, base], capture_output=True,
+                             text=True, timeout=120)
+        assert run.returncode == 0, f"{run.stdout}\n{run.stderr}"
+        assert "OK" in run.stdout
+    finally:
+        for suffix in ".a .b .c .d .e .f".split():
+            try:
+                os.unlink(base + suffix)
+            except OSError:
+                pass

@@ -86,3 +86,33 @@ def test_disabled_is_noop():
     t0 = len(tracing._buffer)
     tracing.record_span("ignored", 0.0, 1.0)
     assert len(tracing._buffer) == t0
+
+
+def test_structured_events(traced_cluster):
+    """System events (actor death) land in the GCS event ring and are
+    queryable; user code can report its own (reference: util/event.cc +
+    export events)."""
+    from ray_tpu.util import events
+
+    events.record("mytest", "warning", "hello events", foo=1)
+
+    @ray_tpu.remote(max_restarts=0)
+    class Doomed:
+        def ping(self):
+            return "ok"
+
+    d = Doomed.remote()
+    ray_tpu.get(d.ping.remote(), timeout=60)
+    ray_tpu.kill(d)
+    import time as _t
+
+    deadline = _t.time() + 30
+    found_user = found_actor = False
+    while _t.time() < deadline and not (found_user and found_actor):
+        evs = events.list_events(limit=500)
+        found_user = any(e["source"] == "mytest"
+                         and e["metadata"].get("foo") == 1 for e in evs)
+        found_actor = any(e["source"] == "actor" for e in evs)
+        _t.sleep(0.5)
+    assert found_user, "user event not recorded"
+    assert found_actor, "actor lifecycle event not recorded"
