@@ -10,6 +10,8 @@ ICI topology) that the scheduler can select on.
 
 from __future__ import annotations
 
+import os
+
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -175,3 +177,30 @@ class WorkerLease:
     worker_pid: int
     node_id: NodeID
     resources: Dict[str, float]
+
+
+def die_with_parent():
+    """Bind this process's lifetime to its parent (PR_SET_PDEATHSIG).
+
+    Called by the CHILD at startup instead of a Popen preexec_fn: a
+    preexec_fn forces subprocess to fork() — which intermittently
+    crashes/deadlocks when the parent is multithreaded (JAX drivers are).
+    Without preexec_fn, subprocess uses posix_spawn. The exec-to-call
+    window can orphan a child if the parent dies in it; the session
+    sweep reclaims those."""
+    try:
+        import ctypes
+        import signal
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
+        # close the exec->arm window: the spawner records its pid in the
+        # child env; a mismatch means the parent died (child reparented)
+        # before we armed. Comparing against a literal init pid would
+        # misfire when the supervisor legitimately IS pid 1 (containers).
+        expected = os.environ.get("RAY_TPU_PARENT_PID")
+        if expected and os.getppid() != int(expected):
+            os._exit(0)
+    except Exception:
+        pass

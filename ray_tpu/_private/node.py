@@ -17,17 +17,6 @@ from typing import Dict, List, Optional
 from ray_tpu._private.config import RAY_CONFIG
 
 
-def _preexec_die_with_parent():
-    try:
-        import ctypes
-        import signal as _signal
-
-        libc = ctypes.CDLL("libc.so.6", use_errno=True)
-        libc.prctl(1, _signal.SIGKILL)  # PR_SET_PDEATHSIG
-    except Exception:
-        pass
-
-
 def _wait_for_file(path: str, timeout: float = 30.0,
                    proc: Optional[subprocess.Popen] = None,
                    what: str = "service") -> str:
@@ -92,7 +81,7 @@ class NodeSupervisor:
             cmd += ["--persist-dir", self.gcs_persist_dir]
         self.gcs_proc = subprocess.Popen(
             cmd, stdout=self._log("gcs_out"), stderr=subprocess.STDOUT,
-            preexec_fn=_preexec_die_with_parent,
+            env=self._child_env(),
         )
         self.processes.append(self.gcs_proc)
         return _wait_for_file(gcs_file)
@@ -115,7 +104,7 @@ class NodeSupervisor:
              "--log-dir", self.log_dir,
              "--address-file", addr_file],
             stdout=self._log("dashboard_out"), stderr=subprocess.STDOUT,
-            preexec_fn=_preexec_die_with_parent,
+            env=self._child_env(),
         )
         self.processes.append(proc)
         self.dashboard_address = _wait_for_file(addr_file, proc=proc,
@@ -158,9 +147,12 @@ class NodeSupervisor:
             cmd += ["--object-store-memory", str(int(osm))]
         proc = subprocess.Popen(cmd, stdout=self._log("raylet_out"),
                                 stderr=subprocess.STDOUT,
-                                preexec_fn=_preexec_die_with_parent)
+                                env=self._child_env())
         self.processes.append(proc)
         return _wait_for_file(addr_file, timeout=60.0)
+
+    def _child_env(self):
+        return dict(os.environ, RAY_TPU_PARENT_PID=str(os.getpid()))
 
     def _log(self, name: str):
         return open(os.path.join(self.log_dir, f"{name}.log"), "ab")

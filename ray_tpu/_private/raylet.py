@@ -46,18 +46,6 @@ from ray_tpu._private.rpc import RpcError, RpcServer, RetryingRpcClient
 logger = logging.getLogger("ray_tpu.raylet")
 
 
-def _preexec():
-    # die with the raylet (Linux): workers must not outlive their node daemon
-    try:
-        import ctypes
-
-        libc = ctypes.CDLL("libc.so.6", use_errno=True)
-        PR_SET_PDEATHSIG = 1
-        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
-    except Exception:
-        pass
-
-
 class _PullRetry(Exception):
     """Internal: the chosen pull source had no usable copy; re-pick."""
 
@@ -125,6 +113,8 @@ class Raylet:
         self._pulls: Dict[bytes, asyncio.Task] = {}
         self._background: List[asyncio.Task] = []
         self._spawn_env = dict(os.environ)
+        # children verify this at startup (die_with_parent window check)
+        self._spawn_env["RAY_TPU_PARENT_PID"] = str(os.getpid())
         self._spawn_sem = asyncio.Semaphore(
             max(1, RAY_CONFIG.worker_startup_concurrency))
         # bounded concurrent inbound pulls (reference: pull_manager.cc's
@@ -340,7 +330,7 @@ class Raylet:
             if renv.get("env_vars"):
                 env = dict(env, **renv["env_vars"])
         proc = subprocess.Popen(
-            cmd, env=env, preexec_fn=_preexec,
+            cmd, env=env,
             stdout=self._log_file("worker_stdout"), stderr=subprocess.STDOUT,
         )
         w = WorkerProc(proc, renv_hash)
@@ -858,6 +848,10 @@ class Raylet:
 
 
 def main():
+    from ray_tpu._private.common import die_with_parent
+
+    die_with_parent()
+
     import argparse
     import json
 

@@ -15,6 +15,10 @@ import time
 
 
 def main():
+    from ray_tpu._private.common import die_with_parent
+
+    die_with_parent()
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--raylet-address", required=True)
     parser.add_argument("--gcs-address", required=True)

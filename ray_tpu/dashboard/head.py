@@ -318,6 +318,10 @@ class DashboardHead:
 
 
 def main():
+    from ray_tpu._private.common import die_with_parent
+
+    die_with_parent()
+
     import argparse
 
     from ray_tpu._private.logs import setup_process_logging

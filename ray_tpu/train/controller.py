@@ -108,7 +108,28 @@ class TrainController:
                 refs = group.run(self.fn_blob, self.config, self._self_handle,
                                  self.manager.latest(), self.run_dir,
                                  self._shards_for(size))
-                results = ray_tpu.get(refs, timeout=24 * 3600)
+                # wait-any, not rank-ordered get: a failure on ANY worker
+                # must trigger recovery immediately — a plain get(refs)
+                # blocks on rank 0 and never notices rank k>0 dying
+                # (reference: the controller's worker poll, controller.py:269)
+                by_idx: Dict[int, Any] = {}
+                pending = {ref: i for i, ref in enumerate(refs)}
+                run_deadline = time.monotonic() + 24 * 3600
+                while pending:
+                    remaining = run_deadline - time.monotonic()
+                    if remaining <= 0:
+                        # a wedged worker (no result, no error) must still
+                        # fall into the failure policy, like the old
+                        # bounded get did
+                        raise TimeoutError(
+                            f"{len(pending)} train workers produced no "
+                            f"result within 24h")
+                    ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                            timeout=min(remaining, 3600.0))
+                    for ref in ready:
+                        by_idx[pending.pop(ref)] = ray_tpu.get(
+                            ref, timeout=300)  # raises the worker's error
+                results = [by_idx[i] for i in range(len(refs))]
                 self.state = "FINISHED"
                 latest = self.manager.latest()
                 return {
