@@ -357,6 +357,39 @@ class _LeasePool:
                         "worker_address": reply["worker_address"],
                         "raylet_address": node["address"],
                         "last_used": time.monotonic()}
+            if reply["status"] == "pg_removed":
+                # the raylet has no live reserve for this group. Confirm
+                # against GCS truth before failing: a stale raylet view
+                # (restart, mid-reschedule) must retry bounded like
+                # "infeasible", while a genuine removal fails queued tasks
+                # now (reference: tasks routed to a removed PG error, they
+                # never reroute to node capacity)
+                pg_info = None
+                try:
+                    pg_info = (await self.core._gcs_call(
+                        "GetPlacementGroup",
+                        {"pg_id": req["pg"]}))["info"]
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass
+                if pg_info is None or pg_info.get("state") == "REMOVED":
+                    raise RuntimeError(
+                        "placement group was removed; queued tasks against "
+                        "its bundles cannot be scheduled")
+                if infeasible_since is None:
+                    infeasible_since = time.monotonic()
+                elif time.monotonic() - infeasible_since > \
+                        RAY_CONFIG.infeasible_task_timeout_s:
+                    raise RuntimeError(
+                        "raylet persistently reports no reserve for a live "
+                        "placement group (stale bundle view?)")
+                # re-pick: a reschedule may have moved the bundle
+                node2 = await self.core._pick_node(opts, resources)
+                if node2 is not None:
+                    node = node2
+                    raylet = self.core._raylet_client(node["address"])
+                await asyncio.sleep(busy_delay)
+                busy_delay = min(busy_delay * 1.5, 2.0)
+                continue
             if reply["status"] == "infeasible":
                 # the raylet's totals reject a shape the GCS view accepts
                 # (e.g. stale PG bundle after a raylet restart): bounded —

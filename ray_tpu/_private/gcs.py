@@ -1141,7 +1141,10 @@ class GcsServer:
                     ok = False
                     break
             if not ok:
-                for nid in prepared:
+                # release EVERY attempted node, not just acked ones: a
+                # prepare that timed out may still have applied on the
+                # raylet (releasing an unprepared pg is a no-op)
+                for nid in per_node:
                     try:
                         await self.node_clients[nid].call("ReleasePGBundles", pickle.dumps(
                             {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0, retries=1)

@@ -472,13 +472,24 @@ class Raylet:
     # ------------------------------------------------------------------
 
     def _lease_pool(self, pg: Optional[bytes], bundle_index: int):
-        if pg is not None and pg in self.pg_available:
-            bundles = self.pg_available[pg]
-            if bundle_index in bundles:
-                return bundles[bundle_index]
-            if bundle_index < 0 and bundles:
-                return bundles[min(bundles.keys())]
-        return self.available
+        """Resolve the resource pool a lease draws from / credits back to.
+
+        Returns None for a PG-backed lease whose group (or bundle) is gone:
+        grants must be refused (the reference fails tasks routed to removed
+        groups, placement_group_resource_manager.cc), and returns must NOT
+        credit the node pool — ReleasePGBundles already returned the whole
+        bundle reserve, so crediting again leaks phantom capacity (+1 CPU
+        per cached lease returning after group removal)."""
+        if pg is None:
+            return self.available
+        bundles = self.pg_available.get(pg)
+        if bundles is None:
+            return None
+        if bundle_index in bundles:
+            return bundles[bundle_index]
+        if bundle_index < 0 and bundles:
+            return bundles[min(bundles.keys())]
+        return None
 
     async def _rpc_RequestWorkerLease(self, req, conn):
         from ray_tpu._private.runtime_env import env_hash
@@ -513,6 +524,8 @@ class Raylet:
         try:
             while True:
                 pool = self._lease_pool(pg, bundle_index)
+                if pool is None:
+                    return {"status": "pg_removed"}
                 if resources_ge(pool, resources):
                     resources_sub(pool, resources)
                     try:
@@ -562,7 +575,8 @@ class Raylet:
         w, resources, pool_key = entry
         pg, bundle_index = pickle.loads(pool_key)
         pool = self._lease_pool(pg, bundle_index)
-        resources_add(pool, resources)
+        if pool is not None:
+            resources_add(pool, resources)
         w.leases.discard(lease_id)
         if w.pid in self.workers and not w.leases:
             w.idle_since = time.monotonic()
@@ -637,7 +651,15 @@ class Raylet:
 
     async def _rpc_PreparePGBundles(self, req, conn):
         pg_id = req["pg_id"]
-        bundles: Dict[int, Dict[str, float]] = req["bundles"]
+        # idempotent per-bundle: a 2PC retry (or a reschedule that re-plans
+        # surviving bundles onto this node) reserves only indices not
+        # already held — never double-subtracting, never no-op'ing away a
+        # genuinely new bundle of the same group
+        already = self.pg_reserved.get(pg_id, {})
+        bundles: Dict[int, Dict[str, float]] = {
+            i: r for i, r in req["bundles"].items() if i not in already}
+        if not bundles:
+            return {"status": "ok"}
         need: Dict[str, float] = {}
         for res in bundles.values():
             for k, v in res.items():

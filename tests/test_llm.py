@@ -162,6 +162,7 @@ def test_serve_llm(ray_local):
     serve_api.shutdown()
 
 
+@pytest.mark.isolated
 def test_data_llm_processor(ray_local):
     from ray_tpu import data as rdata
     from ray_tpu.llm.data_llm import build_llm_processor
