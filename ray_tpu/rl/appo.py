@@ -7,7 +7,9 @@ sampling + surrogate loss + periodic target-network sync + optional KL
 term). The learner is one jitted program: V-trace (lax.scan over time)
 runs on the ONLINE value function and online/behavior ratios (as in the
 rllib learner); the TARGET network's role is the optional KL anchor and
-a stable policy snapshot — no host loops.
+a stable policy snapshot — no host loops. Multi-learner: the core plugs
+into LearnerGroup like IMPALA's (each rank's target syncs in lockstep
+because update counts advance identically on every rank).
 """
 
 from __future__ import annotations
@@ -17,7 +19,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-from ray_tpu.rl.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig, _ImpalaLearnerCore, vtrace_returns
 
 
 @dataclass
@@ -34,29 +36,28 @@ class APPOConfig(IMPALAConfig):
         return APPO
 
 
-class APPO(IMPALA):
-    """Inherits the async pipeline (runners, aggregators, relaunch loop);
-    replaces the learner update with the APPO loss + target network."""
+class _AppoLearnerCore(_ImpalaLearnerCore):
+    """APPO loss + target network on the IMPALA learner chassis."""
 
-    def __init__(self, cfg: APPOConfig):
-        super().__init__(cfg)
+    metric_keys = ("loss", "pg_loss", "vf_loss", "entropy", "mean_ratio")
+
+    def __init__(self, cfg, obs_dim, n_actions, world_size=1, rank=0,
+                 group_name=None):
+        super().__init__(cfg, obs_dim, n_actions, world_size=world_size,
+                         rank=rank, group_name=group_name)
+        self.target_params = self.params
+        self.updates_done = 0
+
+    def _make_loss(self):
         from ray_tpu.utils import import_jax
 
         jax = import_jax()
         import jax.numpy as jnp
-        import optax
 
-        self.target_params = self.params
-        self._updates_done = 0
+        cfg = self.cfg
 
-        from ray_tpu.rl.impala import vtrace_returns
-
-        def vtrace(values, last_value, rewards, dones, rhos):
-            return vtrace_returns(
-                values, last_value, rewards, dones, rhos, gamma=cfg.gamma,
-                rho_clip=cfg.vtrace_rho_clip, c_clip=cfg.vtrace_c_clip)
-
-        def loss_fn(params, target_params, batch):
+        def loss_fn(params, extras, batch):
+            (target_params,) = extras
             T, B = batch["actions"].shape
             obs_flat = batch["obs"].reshape((T * B,) + batch["obs"].shape[2:])
             obs_all = jnp.concatenate([obs_flat, batch["last_obs"]], axis=0)
@@ -69,18 +70,21 @@ class APPO(IMPALA):
             # APPO — V-trace itself runs on the ONLINE value function)
             t_logits_all, _ = self.model.apply(
                 {"params": target_params}, obs_all)
-            t_logits = t_logits_all[: T * B].reshape(T, B, -1)
 
             acts = batch["actions"][..., None].astype(jnp.int32)
             logp_all = jax.nn.log_softmax(logits)
             logp = jnp.take_along_axis(logp_all, acts, axis=-1)[..., 0]
-            t_logp_all = jax.nn.log_softmax(t_logits)
+            t_logp_all = jax.nn.log_softmax(
+                t_logits_all[: T * B].reshape(T, B, -1))
 
             ratio = jnp.exp(logp - batch["behavior_logp"])
-            vs, pg_adv = vtrace(jax.lax.stop_gradient(values),
-                                jax.lax.stop_gradient(last_value),
-                                batch["rewards"], batch["dones"],
-                                jax.lax.stop_gradient(ratio))
+            vs, pg_adv = vtrace_returns(
+                jax.lax.stop_gradient(values),
+                jax.lax.stop_gradient(last_value),
+                batch["rewards"], batch["dones"],
+                jax.lax.stop_gradient(ratio),
+                gamma=cfg.gamma, rho_clip=cfg.vtrace_rho_clip,
+                c_clip=cfg.vtrace_c_clip)
             adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
 
             surr1 = ratio * adv
@@ -97,32 +101,31 @@ class APPO(IMPALA):
             return total, {"pg_loss": pg_loss, "vf_loss": vf_loss,
                            "entropy": entropy, "mean_ratio": ratio.mean()}
 
-        def appo_update(params, target_params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, target_params, batch)
-            updates, opt_state = self.opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, {"loss": loss, **aux}
+        return loss_fn
 
-        self._appo_update = jax.jit(appo_update)
+    def _extras(self):
+        return (self.target_params,)
 
-        def update(params, opt_state, batch):
-            params, opt_state, metrics = self._appo_update(
-                params, self.target_params, opt_state, batch)
-            self._updates_done += 1
-            if self._updates_done % cfg.target_update_freq == 0:
-                self.target_params = params
-            return params, opt_state, metrics
-
-        self._update = update  # IMPALA.training_step drives this
+    def _post_update(self):
+        self.updates_done += 1
+        if self.updates_done % self.cfg.target_update_freq == 0:
+            self.target_params = self.params
 
     def get_state(self) -> Dict[str, Any]:
         state = super().get_state()
-        state["target_params"] = self._to_np(self.target_params)
-        state["updates_done"] = self._updates_done
+        state["target_params"] = self._jax.tree.map(np.asarray,
+                                                    self.target_params)
+        state["updates_done"] = self.updates_done
         return state
 
     def set_state(self, state: Dict[str, Any]) -> None:
         super().set_state(state)
         self.target_params = state.get("target_params", self.params)
-        self._updates_done = state.get("updates_done", 0)
+        self.updates_done = state.get("updates_done", 0)
+
+
+class APPO(IMPALA):
+    """Inherits the async pipeline (runners, aggregators, relaunch loop)
+    and the multi-learner path; only the learner core differs."""
+
+    learner_core_cls = _AppoLearnerCore

@@ -64,6 +64,10 @@ class IMPALAConfig(AlgorithmConfig):
     vtrace_c_clip: float = 1.0
     hidden: tuple = (64, 64)
     num_aggregators: int = 1
+    # multi-learner gradient sync (reference: learner_group.py:101); each
+    # learner consumes its own aggregated batch, grads allreduce-averaged
+    num_learners: int = 1
+    learner_backend: str = "cpu"
 
     @property
     def algo_cls(self):
@@ -159,15 +163,18 @@ class _Aggregator:
         return out
 
 
-class IMPALA(Algorithm):
-    def __init__(self, cfg: IMPALAConfig):
-        import cloudpickle
+class _ImpalaLearnerCore:
+    """Params + optimizer + jitted V-trace update, usable in-process
+    (num_learners=1) or as rank ``rank`` of a LearnerGroup — in the multi
+    case each learner consumes its OWN aggregated batch and gradients are
+    allreduce-averaged before apply (reference:
+    rllib/core/learner/torch/torch_learner.py:524-547), so parameters stay
+    identical across ranks (same seed -> same init)."""
 
-        import gymnasium as gym
+    metric_keys = ("loss", "pg_loss", "vf_loss", "entropy", "mean_rho")
 
-        super().__init__(cfg)
-        if not ray_tpu.is_initialized():
-            ray_tpu.init()
+    def __init__(self, cfg, obs_dim: int, n_actions: int,
+                 world_size: int = 1, rank: int = 0, group_name=None):
         from ray_tpu.utils import import_jax
 
         jax = import_jax()
@@ -176,10 +183,8 @@ class IMPALA(Algorithm):
 
         from ray_tpu.models.actor_critic import ActorCritic
 
-        probe = gym.make(cfg.env)
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        n_actions = int(probe.action_space.n)
-        probe.close()
+        self.cfg = cfg
+        self.world_size, self.rank, self.group_name = world_size, rank, group_name
         self.model = ActorCritic(n_actions, cfg.hidden)
         key = jax.random.PRNGKey(cfg.seed)
         self.params = self.model.init(key, jnp.zeros((1, obs_dim)))["params"]
@@ -187,13 +192,46 @@ class IMPALA(Algorithm):
                                optax.adam(cfg.lr))
         self.opt_state = self.opt.init(self.params)
         self._jax = jax
+        loss_fn = self._make_loss()
 
-        def vtrace(values, last_value, rewards, dones, rhos):
-            return vtrace_returns(
-                values, last_value, rewards, dones, rhos, gamma=cfg.gamma,
-                rho_clip=cfg.vtrace_rho_clip, c_clip=cfg.vtrace_c_clip)
+        def fused(params, opt_state, extras, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, extras, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss, **aux}
 
-        def loss_fn(params, batch):
+        self._fused = jax.jit(fused)
+
+        def grad_fn(params, extras, batch, scale):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, extras, batch)
+            grads = jax.tree.map(lambda g: g * scale, grads)
+            scalars = jnp.stack(
+                [loss] + [aux[k] for k in self.metric_keys[1:]]) * scale
+            return grads, scalars
+
+        self._grad = jax.jit(grad_fn)
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply = jax.jit(apply_fn)
+
+    # -- algorithm-specific pieces (APPO overrides) ---------------------
+
+    def _make_loss(self):
+        """Returns loss_fn(params, extras, batch) -> (total, aux_dict)."""
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+
+        def loss_fn(params, extras, batch):
+            del extras  # IMPALA has no auxiliary learner state
             T, B = batch["actions"].shape
             obs_all = jnp.concatenate(
                 [batch["obs"].reshape((T * B,) + batch["obs"].shape[2:]),
@@ -208,8 +246,10 @@ class IMPALA(Algorithm):
                 logp_all, batch["actions"][..., None].astype(jnp.int32),
                 axis=-1)[..., 0]
             rhos = jnp.exp(logp - batch["behavior_logp"])
-            vs, pg_adv = vtrace(values, last_value, batch["rewards"],
-                                batch["dones"], rhos)
+            vs, pg_adv = vtrace_returns(
+                values, last_value, batch["rewards"], batch["dones"], rhos,
+                gamma=cfg.gamma, rho_clip=cfg.vtrace_rho_clip,
+                c_clip=cfg.vtrace_c_clip)
             pg_loss = -(logp * pg_adv).mean()
             vf_loss = ((values - vs) ** 2).mean()
             entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
@@ -218,14 +258,90 @@ class IMPALA(Algorithm):
                            "entropy": entropy,
                            "mean_rho": rhos.mean()}
 
-        def update(params, opt_state, batch):
-            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, batch)
-            updates, opt_state = self.opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, {"loss": loss, **aux}
+        return loss_fn
 
-        self._update = jax.jit(update)
+    def _extras(self):
+        return ()
+
+    def _post_update(self):
+        pass
+
+    # -- update ---------------------------------------------------------
+
+    def update(self, batch) -> dict:
+        import jax.numpy as jnp
+
+        jbatch = {k: jnp.asarray(v) for k, v in batch.items()
+                  if k != "episode_returns"}
+        if self.world_size == 1:
+            self.params, self.opt_state, metrics = self._fused(
+                self.params, self.opt_state, self._extras(), jbatch)
+            self._post_update()
+            return {k: float(v) for k, v in metrics.items()}
+        from ray_tpu.rl.learner_group import sync_gradients
+
+        grads, scalars = self._grad(self.params, self._extras(), jbatch,
+                                    1.0 / self.world_size)
+        grads, mvec = sync_gradients(grads, np.asarray(scalars),
+                                     self.group_name)
+        self.params, self.opt_state = self._apply(self.params,
+                                                  self.opt_state, grads)
+        self._post_update()
+        return dict(zip(self.metric_keys, map(float, mvec)))
+
+    def get_params(self):
+        return self._jax.tree.map(np.asarray, self.params)
+
+    def get_state(self) -> dict:
+        to_np = self._jax.tree.map
+        return {"params": to_np(np.asarray, self.params),
+                "opt_state": to_np(np.asarray, self.opt_state)}
+
+    def set_state(self, state: dict):
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+class IMPALA(Algorithm):
+    learner_core_cls = _ImpalaLearnerCore
+
+    def __init__(self, cfg: IMPALAConfig):
+        import cloudpickle
+
+        import gymnasium as gym
+
+        super().__init__(cfg)
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        from ray_tpu.utils import import_jax
+
+        self._jax = import_jax()
+
+        probe = gym.make(cfg.env)
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        n_actions = int(probe.action_space.n)
+        probe.close()
+        self.learner_group = None
+        if cfg.num_learners > 1:
+            if cfg.num_learners > cfg.num_env_runners:
+                raise ValueError(
+                    f"num_learners={cfg.num_learners} needs at least as "
+                    f"many env runners (got {cfg.num_env_runners}): each "
+                    f"learner consumes >=1 rollout per update")
+            from ray_tpu.rl.learner_group import LearnerGroup
+
+            core_cls = self.learner_core_cls
+
+            def factory(rank, world_size, group_name, _cfg=cfg, _o=obs_dim,
+                        _n=n_actions, _cls=core_cls):
+                return _cls(_cfg, _o, _n, world_size=world_size, rank=rank,
+                            group_name=group_name)
+
+            self.learner_group = LearnerGroup(
+                factory, cfg.num_learners, backend=cfg.learner_backend)
+            self.core = None
+        else:
+            self.core = self.learner_core_cls(cfg, obs_dim, n_actions)
 
         blob = cloudpickle.dumps(cfg)
         self.runners = [_ImpalaRunner.remote(blob, i)
@@ -234,38 +350,56 @@ class IMPALA(Algorithm):
                             for _ in range(cfg.num_aggregators)]
         self._agg_rr = 0
         # prime the async pipeline: every runner starts sampling immediately
-        params_np = self._to_np(self.params)
+        params_np = self._current_params_np()
         self._inflight = {r.sample.remote(params_np): r for r in self.runners}
         self.env_steps = 0
         self._return_window: List[float] = []
 
-    def _to_np(self, tree):
-        return self._jax.tree.map(np.asarray, tree)
+    def _current_params_np(self):
+        if self.learner_group is not None:
+            return self.learner_group.get_params()
+        return self.core.get_params()
+
+    def _next_aggregator(self):
+        agg = self.aggregators[self._agg_rr % len(self.aggregators)]
+        self._agg_rr += 1
+        return agg
 
     def training_step(self) -> Dict[str, Any]:
-        import jax.numpy as jnp
-
         cfg = self.config
         t0 = time.time()
-        want = min(cfg.num_rollouts_per_update, len(self.runners))
+        n_learners = max(1, cfg.num_learners)
+        want = min(max(cfg.num_rollouts_per_update, n_learners),
+                   len(self.runners))
         ready, _ = ray_tpu.wait(list(self._inflight), num_returns=want,
                                 timeout=600)
         rollout_refs = []
-        params_np = self._to_np(self.params)
+        params_np = self._current_params_np()
         for ref in ready:
             runner = self._inflight.pop(ref)
             rollout_refs.append(ref)
             # relaunch with current weights — the runner never idles
             self._inflight[runner.sample.remote(params_np)] = runner
-        agg = self.aggregators[self._agg_rr % len(self.aggregators)]
-        self._agg_rr += 1
-        batch = ray_tpu.get(agg.stack.remote(*rollout_refs), timeout=600)
-        self._return_window.extend(batch.pop("episode_returns").tolist())
+        if self.learner_group is None:
+            batch = ray_tpu.get(
+                self._next_aggregator().stack.remote(*rollout_refs),
+                timeout=600)
+            self._return_window.extend(batch.pop("episode_returns").tolist())
+            metrics = self.core.update(batch)
+            steps = int(np.prod(batch["actions"].shape))
+        else:
+            # one aggregated batch per learner (round-robin over the ready
+            # rollouts); gradients sync inside the group
+            groups = [rollout_refs[i::n_learners] for i in range(n_learners)]
+            batches = ray_tpu.get(
+                [self._next_aggregator().stack.remote(*g) for g in groups],
+                timeout=600)
+            steps = 0
+            for b in batches:
+                self._return_window.extend(b.pop("episode_returns").tolist())
+                steps += int(np.prod(b["actions"].shape))
+            metrics = self.learner_group.update_shards(batches)
         self._return_window = self._return_window[-100:]
-        jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
-        self.params, self.opt_state, metrics = self._update(
-            self.params, self.opt_state, jbatch)
-        steps = int(np.prod(batch["actions"].shape))
         self.env_steps += steps
         return {
             "episode_return_mean": (float(np.mean(self._return_window))
@@ -277,16 +411,23 @@ class IMPALA(Algorithm):
         }
 
     def get_state(self):
-        return {"params": self._to_np(self.params),
-                "opt_state": self._to_np(self.opt_state),
-                "env_steps": self.env_steps}
+        if self.learner_group is not None:
+            state = self.learner_group.get_state()
+        else:
+            state = self.core.get_state()
+        state["env_steps"] = self.env_steps
+        return state
 
     def set_state(self, state):
-        self.params = state["params"]
-        self.opt_state = state["opt_state"]
-        self.env_steps = state["env_steps"]
+        self.env_steps = state.get("env_steps", 0)
+        if self.learner_group is not None:
+            self.learner_group.set_state(state)
+        else:
+            self.core.set_state(state)
 
     def stop(self):
+        if self.learner_group is not None:
+            self.learner_group.shutdown()
         for a in list(self.runners) + list(self.aggregators):
             try:
                 ray_tpu.kill(a)

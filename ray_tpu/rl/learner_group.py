@@ -1,0 +1,170 @@
+"""Multi-learner gradient sync over the collective substrate.
+
+Reference: rllib/core/learner/learner_group.py:101 — a LearnerGroup spawns
+``num_learners`` remote Learner actors, fans each training batch out to
+them, and the learners average gradients before applying updates so their
+parameters stay identical (rllib/core/learner/torch/torch_learner.py:524-547
+does this with torch DDP). Re-based on this framework's own collective
+layer: the CpuStoreGroup tier in CI, XlaGroup over ICI on device — the
+last BASELINE.json north-star capability ("multi-learner group uses the
+XLA collective backend for gradient sync").
+
+The sync contract every learner core follows: compute gradients on its
+shard as *global-denominator contributions* (weighted sums divided by the
+global sample count), allreduce-SUM one flat vector of
+``[raveled grads | metric scalars]``, unravel, apply. With identical
+parameter initialization (same seed on every rank) and identical reduced
+gradients, parameters never diverge across learners.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+
+def sync_gradients(grads, scalars: np.ndarray, group_name: str):
+    """Allreduce-SUM a gradient pytree and a metrics vector in ONE
+    collective call. Returns (reduced_grads, reduced_scalars).
+
+    The caller is responsible for scaling: local grads must already be
+    global-denominator contributions (sum over ranks == the global-batch
+    gradient), and scalars likewise — the sum across ranks IS the value.
+    """
+    from ray_tpu import collective as col
+    from ray_tpu.utils import import_jax
+
+    import_jax()
+    from jax.flatten_util import ravel_pytree
+
+    flat, unravel = ravel_pytree(grads)
+    flat = np.asarray(flat, np.float32)
+    vec = np.concatenate([flat, np.asarray(scalars, np.float32)])
+    out = np.asarray(col.allreduce(vec, group_name=group_name))
+    return unravel(out[: flat.size]), out[flat.size:]
+
+
+class _LearnerWorker:
+    """One learner actor: rank ``rank`` of the gradient-sync group.
+
+    ``factory(rank, world_size, group_name)`` builds the algorithm-specific
+    learner core (e.g. PPOLearner), which must expose ``update(batch)``,
+    ``get_params()``, ``get_state()``, ``set_state(state)``.
+    """
+
+    def __init__(self, factory_blob: bytes, rank: int, world_size: int,
+                 group_name: str, backend: str):
+        import cloudpickle
+
+        from ray_tpu import collective as col
+
+        if world_size > 1:
+            col.init_collective_group(world_size, rank, backend=backend,
+                                      group_name=group_name)
+        factory: Callable = cloudpickle.loads(factory_blob)
+        self.core = factory(rank=rank, world_size=world_size,
+                            group_name=group_name if world_size > 1 else None)
+        self.rank = rank
+
+    def ready(self) -> int:
+        return self.rank
+
+    def update(self, batch) -> Dict[str, float]:
+        return self.core.update(batch)
+
+    def get_params(self):
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        return jax.tree.map(np.asarray, self.core.get_params())
+
+    def get_state(self):
+        return self.core.get_state()
+
+    def set_state(self, state):
+        self.core.set_state(state)
+
+    def call(self, method: str, *args, **kwargs):
+        """Escape hatch for algorithm-specific learner methods."""
+        return getattr(self.core, method)(*args, **kwargs)
+
+
+class LearnerGroup:
+    """Driver-side handle on N learner actors with synced gradients
+    (reference: rllib/core/learner/learner_group.py:101).
+
+    ``update(batch)`` ships the batch once through the object store (every
+    learner receives the same ref; each slices its own shard per the sync
+    contract) and returns rank 0's metrics — ranks agree on all reduced
+    metrics by construction.
+    """
+
+    def __init__(self, factory: Callable, num_learners: int,
+                 backend: str = "cpu", group_name: Optional[str] = None,
+                 num_cpus_per_learner: float = 1.0):
+        import cloudpickle
+        import uuid
+
+        if num_learners < 1:
+            raise ValueError("num_learners must be >= 1")
+        self.num_learners = num_learners
+        self.group_name = group_name or f"learner_group:{uuid.uuid4().hex[:8]}"
+        blob = cloudpickle.dumps(factory)
+        worker_cls = ray_tpu.remote(_LearnerWorker)
+        self.workers = [
+            worker_cls.options(num_cpus=num_cpus_per_learner).remote(
+                blob, rank, num_learners, self.group_name, backend)
+            for rank in range(num_learners)
+        ]
+        # rendezvous: every rank must be constructed (and its collective
+        # side initialized) before the first update, or rank 0's allreduce
+        # would block against missing peers
+        ray_tpu.get([w.ready.remote() for w in self.workers], timeout=300)
+
+    def update(self, batch) -> Dict[str, float]:
+        ref = ray_tpu.put(batch)
+        metrics = ray_tpu.get(
+            [w.update.remote(ref) for w in self.workers], timeout=600)
+        return metrics[0]
+
+    def update_shards(self, batches: List[Any]) -> Dict[str, float]:
+        """One synced update where each learner consumes its OWN batch
+        (async algorithms: IMPALA/APPO feed different aggregated rollouts
+        to each learner; gradients are still averaged). ``batches`` must
+        have exactly num_learners entries — every rank must join the
+        allreduce or the group deadlocks."""
+        if len(batches) != self.num_learners:
+            raise ValueError(
+                f"update_shards needs exactly {self.num_learners} batches, "
+                f"got {len(batches)}")
+        metrics = ray_tpu.get(
+            [w.update.remote(b) for w, b in zip(self.workers, batches)],
+            timeout=600)
+        return metrics[0]
+
+    def get_params(self):
+        return ray_tpu.get(self.workers[0].get_params.remote(), timeout=300)
+
+    def get_state(self):
+        return ray_tpu.get(self.workers[0].get_state.remote(), timeout=300)
+
+    def set_state(self, state):
+        ref = ray_tpu.put(state)
+        ray_tpu.get([w.set_state.remote(ref) for w in self.workers],
+                    timeout=300)
+
+    def foreach_learner(self, method: str, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(
+            [w.call.remote(method, *args, **kwargs) for w in self.workers],
+            timeout=600)
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

@@ -35,6 +35,10 @@ class PPOConfig(AlgorithmConfig):
     epochs: int = 8
     num_minibatches: int = 4
     hidden: tuple = (64, 64)
+    # multi-learner gradient sync (reference: learner_group.py:101
+    # num_learners); backend "cpu" = CpuStoreGroup CI tier, "xla" = ICI
+    num_learners: int = 1
+    learner_backend: str = "cpu"
 
     @property
     def algo_cls(self):
@@ -132,10 +136,21 @@ class EnvRunner:
 
 
 class PPOLearner:
-    """jit-compiled PPO update (single process; LearnerGroup shards batches
-    over a mesh via psum in later rounds)."""
+    """jit-compiled PPO update.
 
-    def __init__(self, cfg: PPOConfig, obs_dim: int, n_actions: int):
+    Single-process by default; with ``world_size > 1`` it is rank ``rank``
+    of a LearnerGroup (learner_group.py): every rank sees the full batch,
+    derives the SAME seeded minibatch permutation, computes gradients on
+    its 1/world slice of each minibatch as global-denominator
+    contributions, and allreduce-SUMs them — so the reduced gradient (and
+    the advantage-normalization statistics, synced the same way) exactly
+    equal the single-learner computation and parameters never diverge.
+    Reference: rllib/core/learner/torch/torch_learner.py:524-547 (DDP
+    gradient averaging), re-based on the collective layer."""
+
+    def __init__(self, cfg: PPOConfig, obs_dim: int, n_actions: int,
+                 world_size: int = 1, rank: int = 0,
+                 group_name: Optional[str] = None):
         from ray_tpu.utils import import_jax
 
         jax = import_jax()
@@ -151,6 +166,9 @@ class PPOLearner:
         self.opt = optax.chain(optax.clip_by_global_norm(0.5), optax.adam(cfg.lr))
         self.opt_state = self.opt.init(self.params)
         self._jax = jax
+        self.world_size = world_size
+        self.rank = rank
+        self.group_name = group_name
 
         def loss_fn(params, batch):
             logits, values = self.model.apply({"params": params}, batch["obs"])
@@ -179,7 +197,45 @@ class PPOLearner:
 
         self._update_minibatch = jax.jit(update_minibatch)
 
+        # distributed path: same loss with explicit per-sample weights and
+        # externally-supplied (globally synced) advantage statistics, split
+        # into grad-shard / apply so the allreduce sits between them
+        def loss_shard(params, batch, w, adv_mean, adv_std, denom):
+            logits, values = self.model.apply({"params": params}, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp"])
+            adv = (batch["advantages"] - adv_mean) / (adv_std + 1e-8)
+            pg1 = ratio * adv
+            pg2 = jnp.clip(ratio, 1 - cfg.clip_eps, 1 + cfg.clip_eps) * adv
+            pg_loss = -(w * jnp.minimum(pg1, pg2)).sum() / denom
+            vf_loss = (w * (values - batch["returns"]) ** 2).sum() / denom
+            ent = (w * -(jnp.exp(logp_all) * logp_all).sum(-1)).sum() / denom
+            total = pg_loss + cfg.vf_coef * vf_loss - cfg.entropy_coef * ent
+            return total, jnp.stack([total, pg_loss, vf_loss, ent])
+
+        def grad_shard(params, batch, w, adv_mean, adv_std, denom):
+            (_, scalars), grads = jax.value_and_grad(
+                loss_shard, has_aux=True)(params, batch, w, adv_mean,
+                                          adv_std, denom)
+            return grads, scalars
+
+        self._grad_shard = jax.jit(grad_shard)
+        self._adv_stats = jax.jit(
+            lambda adv, w: jnp.stack([w.sum(), (w * adv).sum(),
+                                      (w * adv * adv).sum()]))
+
+        def apply_grads(params, opt_state, grads):
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply_grads = jax.jit(apply_grads)
+
     def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self.world_size > 1:
+            return self._update_distributed(batch)
         import numpy as _np
 
         cfg = self.cfg
@@ -197,6 +253,66 @@ class PPOLearner:
                 (self.params, self.opt_state), metrics = self._update_minibatch(
                     (self.params, self.opt_state), minibatch)
         return {k: float(v) for k, v in metrics.items()}
+
+    def _update_distributed(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """Rank's share of one LearnerGroup update (see class docstring).
+
+        Two collectives per minibatch: a 3-float advantage-stats allreduce
+        (global weighted mean/var — normalization must NOT use shard-local
+        statistics or ranks compute different losses), then the flat
+        gradient+metrics allreduce. Minibatches are padded to a multiple of
+        world_size with zero-weight repeats so shard shapes stay static
+        for jit."""
+        import numpy as _np
+
+        from ray_tpu import collective as col
+        from ray_tpu.rl.learner_group import sync_gradients
+
+        cfg, W = self.cfg, self.world_size
+        keys = [k for k in batch if k != "episode_returns"]
+        n = len(batch["obs"])
+        idx = _np.arange(n)
+        rng = _np.random.default_rng(cfg.seed)
+        mb = max(1, n // cfg.num_minibatches)
+        mvec = _np.zeros(4, _np.float32)
+        for _ in range(cfg.epochs):
+            rng.shuffle(idx)
+            for start in range(0, n, mb):
+                sel = idx[start:start + mb]
+                shard = -(-len(sel) // W)
+                pad = shard * W - len(sel)
+                w = _np.ones(len(sel), _np.float32)
+                if pad:
+                    sel = _np.concatenate([sel, _np.repeat(sel[-1], pad)])
+                    w = _np.concatenate([w, _np.zeros(pad, _np.float32)])
+                lo = self.rank * shard
+                msel, mw = sel[lo:lo + shard], w[lo:lo + shard]
+                mbatch = {k: batch[k][msel] for k in keys}
+                stats = _np.asarray(col.allreduce(
+                    _np.asarray(self._adv_stats(mbatch["advantages"], mw)),
+                    group_name=self.group_name))
+                wsum = float(stats[0])
+                mean = float(stats[1]) / wsum
+                std = max(float(stats[2]) / wsum - mean * mean, 0.0) ** 0.5
+                grads, scalars = self._grad_shard(
+                    self.params, mbatch, mw, mean, std, wsum)
+                grads, mvec = sync_gradients(grads, _np.asarray(scalars),
+                                             self.group_name)
+                self.params, self.opt_state = self._apply_grads(
+                    self.params, self.opt_state, grads)
+        return {"loss": float(mvec[0]), "pg_loss": float(mvec[1]),
+                "vf_loss": float(mvec[2]), "entropy": float(mvec[3])}
+
+    def get_state(self):
+        import jax
+
+        to_np = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
+        return {"params": to_np(self.params),
+                "opt_state": to_np(self.opt_state)}
+
+    def set_state(self, state):
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
 
     def get_params(self):
         return self.params
@@ -218,7 +334,20 @@ class PPO(Algorithm):
         obs_dim = int(np.prod(probe.observation_space.shape))
         n_actions = int(probe.action_space.n)
         probe.close()
-        self.learner = PPOLearner(cfg, obs_dim, n_actions)
+        self.learner_group = None
+        if cfg.num_learners > 1:
+            from ray_tpu.rl.learner_group import LearnerGroup
+
+            def factory(rank, world_size, group_name,
+                        _cfg=cfg, _obs=obs_dim, _na=n_actions):
+                return PPOLearner(_cfg, _obs, _na, world_size=world_size,
+                                  rank=rank, group_name=group_name)
+
+            self.learner_group = LearnerGroup(
+                factory, cfg.num_learners, backend=cfg.learner_backend)
+            self.learner = None
+        else:
+            self.learner = PPOLearner(cfg, obs_dim, n_actions)
         blob = cloudpickle.dumps(cfg)
         self.runners = [EnvRunner.remote(blob, i)
                         for i in range(cfg.num_env_runners)]
@@ -227,15 +356,20 @@ class PPO(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         """One iteration: parallel sampling -> PPO update -> weight sync."""
         t0 = time.time()
-        params = self.learner.get_params()
-        params_np = self._jax_to_np(params)
+        if self.learner_group is not None:
+            params_np = self.learner_group.get_params()
+        else:
+            params_np = self._jax_to_np(self.learner.get_params())
         sample_refs = [r.sample.remote(params_np) for r in self.runners]
         rollouts = ray_tpu.get(sample_refs, timeout=600)
         batch = {
             k: np.concatenate([r[k] for r in rollouts])
             for k in rollouts[0]
         }
-        metrics = self.learner.update(batch)
+        if self.learner_group is not None:
+            metrics = self.learner_group.update(batch)
+        else:
+            metrics = self.learner.update(batch)
         self._return_window.extend(batch["episode_returns"].tolist())
         self._return_window = self._return_window[-100:]
         steps = len(batch["obs"])
@@ -254,14 +388,21 @@ class PPO(Algorithm):
         return jax.tree.map(lambda x: np.asarray(x), tree)
 
     def get_state(self):
+        if self.learner_group is not None:
+            return self.learner_group.get_state()
         return {"params": self._jax_to_np(self.learner.params),
                 "opt_state": self._jax_to_np(self.learner.opt_state)}
 
     def set_state(self, state):
+        if self.learner_group is not None:
+            self.learner_group.set_state(state)
+            return
         self.learner.params = state["params"]
         self.learner.opt_state = state["opt_state"]
 
     def stop(self):
+        if self.learner_group is not None:
+            self.learner_group.shutdown()
         for r in self.runners:
             try:
                 ray_tpu.kill(r)
