@@ -105,6 +105,8 @@ _d("object_store_memory", 2 * 1024**3)
 _d("object_inline_max_bytes", 100 * 1024)
 _d("object_chunk_bytes", 8 * 1024**2)
 _d("object_spill_dir", "")  # default: <session>/spill
+# spill backend: "" / "filesystem" | "s3://bucket/prefix" | "module:Class"
+_d("object_spill_storage", "")
 _d("object_pull_timeout_s", 120.0)
 _d("object_store_backend", "auto")  # "auto" | "cpp" | "shm"
 # pre-touch this much of the arena at start: first-touch page faults on
@@ -121,6 +123,7 @@ _d("max_lineage_bytes", 64 * 1024**2)
 # ownership-based distributed refcounting (reference: reference_counter.h:44)
 _d("distributed_refcounting", 1)
 _d("free_grace_s", 1.0)  # settle delay before a zero-ref free (in-flight borrows)
+_d("gcs_freed_tombstone_cap", 200000)  # bounded freed-object tombstone ring
 # sustained unreachability before an owner declares a borrower dead and
 # reclaims its borrows; borrowers re-assert every 30s, so partitions shorter
 # than this are fully safe and longer ones only lose non-reconstructable data

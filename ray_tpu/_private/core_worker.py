@@ -515,6 +515,7 @@ class CoreWorker:
         self._result_futures: Dict[ObjectID, asyncio.Future] = {}
         self._in_store: Dict[ObjectID, bool] = {}
         self._tasks: Dict[TaskID, dict] = {}  # lineage / retry records
+        self._actor_inflight: Dict[TaskID, dict] = {}  # for cancel()
         self._lineage_bytes = 0
         # ownership refcounting (reference: reference_counter.h:44)
         self.ref_counter = ReferenceCounter(lambda: self.address)
@@ -541,6 +542,7 @@ class CoreWorker:
         # cancels that arrived before their task started, plus every tid a
         # cancel was requested for (stray async-exc detection)
         self._running_tasks: Dict[bytes, int] = {}
+        self._running_async_tasks: Dict[bytes, asyncio.Task] = {}
         self._cancelled_pending: set = set()
         self._cancel_requested: set = set()
         # streaming generators: task_id -> {produced, total, error, event}
@@ -1777,15 +1779,28 @@ class CoreWorker:
 
         def _kickoff():
             view = self._actor_view(handle.actor_id)
+            self._actor_inflight[task_id] = record
             asyncio.ensure_future(self._drive_actor_task(view, record))
 
         self._queue_kickoff(_kickoff)
         return refs[0] if num_returns == 1 else refs
 
     async def _drive_actor_task(self, view: _ActorView, record: dict):
+        try:
+            await self._drive_actor_task_inner(view, record)
+        finally:
+            self._actor_inflight.pop(record["spec"].task_id, None)
+
+    async def _drive_actor_task_inner(self, view: _ActorView, record: dict):
+        from ray_tpu.exceptions import TaskCancelledError
+
         spec: TaskSpec = record["spec"]
         deadline = time.monotonic() + 3600.0
         while True:
+            if record.get("_cancelled") and not record.get("_pushed_to"):
+                # cancelled while waiting for the actor: never push
+                self._complete_error(record, TaskCancelledError())
+                return
             if view.state == "DEAD":
                 self._complete_error(record, TaskError(
                     f"ActorDiedError: actor {view.actor_id.hex()[:12]} is dead "
@@ -1817,6 +1832,7 @@ class CoreWorker:
                 spec.seqno = view.seqno
                 record["epoch"] = record.get("epoch", -1) + 1
                 spec.attempt = record["epoch"]
+                record["_pushed_to"] = view.address
                 # short connect timeout + one blind reconnect: the address came
                 # from an ALIVE view, so an unreachable peer means the view is
                 # stale — fail fast into the GCS recheck below (the real retry
@@ -1827,6 +1843,12 @@ class CoreWorker:
                     retries=0, connect_timeout=2.0, presend_retries=1))
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 view.state = "UNKNOWN"
+                record.pop("_pushed_to", None)  # not running anywhere now
+                if record.get("_cancelled"):
+                    # cancelled + push failed: never re-push to the next
+                    # incarnation (the normal-task path's :215 recheck)
+                    self._complete_error(record, TaskCancelledError())
+                    return
                 await asyncio.sleep(0.2)
                 record["attempts"] += 1
                 if record["attempts"] > max(record["max_retries"], 0):
@@ -1973,8 +1995,10 @@ class CoreWorker:
         core_worker.cc). A still-queued task completes immediately with
         TaskCancelledError; a running task gets TaskCancelledError raised
         into its thread (cooperative), or its worker killed with
-        force=True. Finished tasks are a no-op. Actor tasks are not
-        cancellable (matches the reference's sync-actor limitation).
+        force=True. Finished tasks are a no-op. Actor tasks: queued calls
+        are dropped, running ASYNC calls are asyncio-cancelled, running
+        sync calls get the cooperative async-exc; force=True is refused
+        (matching the reference — it would kill the actor).
         ``recursive`` is accepted for API parity; this runtime does not
         track child-task trees. Accepts an ObjectRef or an
         ObjectRefGenerator (streaming task)."""
@@ -1989,11 +2013,30 @@ class CoreWorker:
     async def _cancel_async(self, task_id: TaskID, force: bool):
         from ray_tpu.exceptions import TaskCancelledError
 
-        rec = self._tasks.get(task_id)
+        rec = self._tasks.get(task_id) or self._actor_inflight.get(task_id)
         if rec is None:
             return  # finished-and-released or unknown: no-op
         if rec["spec"].actor_id is not None:
-            raise ValueError("actor tasks cannot be cancelled")
+            # reference: CancelTask's actor path — queued calls are dropped,
+            # running ASYNC calls are cancelled cooperatively; force-kill is
+            # refused (it would take the whole actor down)
+            if force:
+                raise ValueError(
+                    "force=True is not supported for actor tasks (it would "
+                    "kill the actor); use ray_tpu.kill(actor) for that")
+            if rec.get("_completed"):
+                return
+            rec["_cancelled"] = True
+            addr = rec.get("_pushed_to")
+            if addr:
+                try:
+                    await self._worker_client(addr).call(
+                        "CancelTask", pickle.dumps(
+                            {"task_id": rec["spec"].task_id.binary(),
+                             "force": False}), timeout=10.0, retries=1)
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass  # actor death completes the call by itself
+            return
         if rec.get("_completed"):
             return  # finished: never signal (or force-kill!) its worker
         rec["_cancelled"] = True
@@ -2160,6 +2203,11 @@ class CoreWorker:
             self._cancel_requested.add(req["task_id"])
             if len(self._cancel_requested) > 1024:
                 self._cancel_requested.pop()
+            atask = self._running_async_tasks.get(req["task_id"])
+            if atask is not None:
+                if not atask.done():
+                    atask.cancel()
+                return pickle.dumps({"status": "ok"})
             ident = self._running_tasks.get(req["task_id"])
             if ident is not None:
                 import ctypes
@@ -2644,11 +2692,30 @@ class CoreWorker:
         args, kwargs, seen_refs = await self._resolve_args(spec.args_blob)
         t0 = time.time()
         if asyncio.iscoroutinefunction(method):
+            from ray_tpu.exceptions import TaskCancelledError
+
+            tid_b = spec.task_id.binary()
             async with self._actor_sem:
-                try:
-                    result, err = await method(*args, **kwargs), None
-                except Exception as e:
-                    result, err = None, TaskError(repr(e), traceback.format_exc())
+                if tid_b in self._cancelled_pending:
+                    # cancelled while queued behind the concurrency cap
+                    self._cancelled_pending.discard(tid_b)
+                    result, err = None, TaskCancelledError(
+                        "TaskCancelledError: cancelled before execution", "")
+                else:
+                    # run as a child task so CancelTask can .cancel() it
+                    # without touching this RPC handler (reference:
+                    # async-actor cooperative cancellation)
+                    atask = asyncio.ensure_future(method(*args, **kwargs))
+                    self._running_async_tasks[tid_b] = atask
+                    try:
+                        result, err = await atask, None
+                    except asyncio.CancelledError:
+                        result, err = None, TaskCancelledError()
+                    except Exception as e:
+                        result, err = None, TaskError(repr(e),
+                                                      traceback.format_exc())
+                    finally:
+                        self._running_async_tasks.pop(tid_b, None)
         else:
             result, err = await self.loop.run_in_executor(
                 self._exec_pool, self._call_user_fn, method, args, kwargs, spec)

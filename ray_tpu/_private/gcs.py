@@ -151,6 +151,7 @@ class GcsServer:
         # visible, displaced copies are deleted at their nodes (reference:
         # plasma's seal-once, obj_lifecycle_mgr.cc)
         self.object_dir: Dict[bytes, dict] = {}
+        self._freed_ring: "deque[bytes]" = deque()  # bounded tombstone FIFO
         self.subs: Dict[int, Tuple[ServerConnection, Set[str]]] = {}
         self.conn_jobs: Dict[int, JobID] = {}
         self._worker_clients: Dict[str, RetryingRpcClient] = {}
@@ -587,7 +588,13 @@ class GcsServer:
         freed tombstone (reference: the owner's delete fan-out on ref-count
         zero). The tombstone's infinite epoch makes any late announce (e.g.
         a pull that completed mid-free) route into the stale-copy deletion
-        path instead of resurrecting the object. Purged at job end."""
+        path instead of resurrecting the object.
+
+        Tombstones are BOUNDED: a FIFO ring of gcs_freed_tombstone_cap ids
+        (oldest evicted first), not held until job end — a long-running job
+        with high object churn would otherwise grow the directory without
+        limit. Evicting a tombstone only re-opens the (already tiny) window
+        for an announce delayed past tens of thousands of subsequent frees."""
         per_node: Dict[NodeID, List[bytes]] = {}
         for oid in req["oids"]:
             entry = self.object_dir.get(oid)
@@ -596,6 +603,13 @@ class GcsServer:
                     per_node.setdefault(node_id, []).append(oid)
             self.object_dir[oid] = {"attempt": self._FREED_EPOCH,
                                     "nodes": set()}
+            self._freed_ring.append(oid)
+        cap = RAY_CONFIG.gcs_freed_tombstone_cap
+        while len(self._freed_ring) > cap:
+            old = self._freed_ring.popleft()
+            stale = self.object_dir.get(old)
+            if stale is not None and stale["attempt"] == self._FREED_EPOCH:
+                del self.object_dir[old]
         for node_id, oids in per_node.items():
             client = self.node_clients.get(node_id)
             info = self.nodes.get(node_id)

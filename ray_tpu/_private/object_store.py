@@ -225,7 +225,12 @@ class ObjectStoreServer:
         self.capacity = capacity or RAY_CONFIG.object_store_memory
         self.used = 0
         self.spill_dir = spill_dir or (RAY_CONFIG.object_spill_dir or f"/tmp/ray_tpu_sessions/spill_{node_hex[:8]}")
-        os.makedirs(self.spill_dir, exist_ok=True)
+        from ray_tpu._private.external_storage import setup_external_storage
+
+        # pluggable spill backend (reference: _private/external_storage.py):
+        # local fs by default; s3://... or a module:Class plugin via config
+        self.storage = setup_external_storage(
+            RAY_CONFIG.object_spill_storage, self.spill_dir)
         self.objects: Dict[bytes, _Entry] = {}
         self.waiters: Dict[bytes, List[asyncio.Future]] = {}
         self.num_spilled = 0
@@ -307,10 +312,7 @@ class ObjectStoreServer:
 
     def _spill(self, oid: bytes):
         e = self.objects[oid]
-        path = os.path.join(self.spill_dir, oid.hex())
-        with open(path, "wb") as f:
-            f.write(self._region(e))
-        e.spill_path = path
+        e.spill_path = self.storage.spill(oid.hex(), self._region(e))
         e.state = "SPILLED"
         if e.arena_offset is not None:
             self.arena.free(e.arena_key)
@@ -326,8 +328,7 @@ class ObjectStoreServer:
         e = self.objects[oid]
         if not self._evict_for(e.size):
             return False
-        with open(e.spill_path, "rb") as f:
-            data = f.read()
+        data = self.storage.restore(e.spill_path)
         if self.arena is not None:
             e.arena_key = e.arena_key or self._arena_key(oid, e.attempt)
             off = self.arena.alloc(e.arena_key, e.size)
@@ -340,7 +341,7 @@ class ObjectStoreServer:
             shm = ShmSegment(self._shm_name(oid, e.attempt), e.size, create=True)
             shm.buf[:] = data
             e.shm, e.shm_name = shm, shm.name
-        os.unlink(e.spill_path)
+        self.storage.delete(e.spill_path)
         e.spill_path = ""
         e.state = "SEALED"
         self.used += e.size
@@ -397,10 +398,7 @@ class ObjectStoreServer:
             e.shm.close()
             e.shm.unlink()
         if e.spill_path:
-            try:
-                os.unlink(e.spill_path)
-            except FileNotFoundError:
-                pass
+            self.storage.delete(e.spill_path)
 
     def put_inline(self, oid: bytes, blob: bytes, attempt: int = 0) -> bool:
         existing = self.objects.get(oid)
@@ -490,9 +488,7 @@ class ObjectStoreServer:
         if e.inline is not None:
             return e.inline[offset : offset + length]
         if e.state == "SPILLED":
-            with open(e.spill_path, "rb") as f:
-                f.seek(offset)
-                return f.read(length)
+            return self.storage.restore_range(e.spill_path, offset, length)
         return bytes(self._region(e)[offset : offset + length])
 
     def object_size(self, oid: bytes) -> Optional[int]:
@@ -530,10 +526,7 @@ class ObjectStoreServer:
                 e.shm.close()
                 e.shm.unlink()
             if e.spill_path:
-                try:
-                    os.unlink(e.spill_path)
-                except FileNotFoundError:
-                    pass
+                self.storage.delete(e.spill_path)
 
     _ZERO_CHUNK = b"\x00" * (8 * 1024 * 1024)
 

@@ -264,13 +264,24 @@ class XlaGroup:
 
         def build():
             def f(x):
-                # each member contributes its full array; replicated in-spec
-                # models that in single-process simulation
+                # same convention as every sibling op: the member's axis-0
+                # chunk IS its contribution (shape t); it receives its
+                # piece of the reduced chunk (shape t/world), so the
+                # assembled output is (t,) with member i's piece at [i]
                 return jax.lax.psum_scatter(x, "ici", scatter_dimension=0, tiled=True)
 
-            return self._shmap(f, P(), P("ici"))
+            return self._shmap(f, P("ici"), P("ici"))
 
+        if op != ReduceOp.SUM:
+            raise ValueError(
+                f"XlaGroup.reducescatter supports SUM only (psum_scatter); "
+                f"got {op}")
         x = jnp.asarray(tensor)
+        if x.shape[0] % (self.mesh.size ** 2) != 0:
+            raise ValueError(
+                f"reducescatter input axis 0 ({x.shape[0]}) must be "
+                f"divisible by devices^2 ({self.mesh.size ** 2}): axis 0 "
+                f"splits into per-member chunks, each scattered again")
         return self._op(f"rs_{x.shape}_{x.dtype}", build)(x)
 
     def alltoall(self, tensor):

@@ -189,14 +189,22 @@ class CrossHostWriter:
         self._w = worker_mod.global_worker()
 
     def write(self, value: Any, timeout: Optional[float] = 300.0):
+        import asyncio
         import pickle as _p
 
         blob = dumps_oob(value)
-        for mbox, addr in self._targets:
-            self._w._run(self._w._worker_client(addr).call(
-                "ChanPush", _p.dumps({"name": mbox, "blob": blob}),
-                timeout=timeout or 300.0, retries=0),
-                (timeout or 300.0) + 10.0)
+        t = timeout or 300.0
+        # concurrent fan-out: one slow reader only costs its own mailbox
+        # push, not a serial wait in front of every later reader (the
+        # bounded mailbox still backpressures the writer per-reader)
+        calls = [self._w._worker_client(addr).call(
+            "ChanPush", _p.dumps({"name": mbox, "blob": blob}),
+            timeout=t, retries=0) for mbox, addr in self._targets]
+
+        async def _fanout():
+            await asyncio.gather(*calls)
+
+        self._w._run(_fanout(), t + 10.0)
 
     def read(self, timeout: float = 300.0):
         raise RuntimeError("cross-host channel writer cannot read")

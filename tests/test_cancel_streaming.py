@@ -177,3 +177,69 @@ def test_streaming_non_generator_errors(cluster):
     it = not_a_gen.remote()
     with pytest.raises(Exception, match="did not return a generator"):
         next(it)
+
+
+# ---------------------------------------------------------------------------
+# actor-task cancellation (reference: CancelTask actor paths; queued calls
+# dropped, running async calls asyncio-cancelled, force refused)
+# ---------------------------------------------------------------------------
+
+
+def test_cancel_queued_actor_task(cluster):
+    @ray_tpu.remote(num_cpus=0.5)
+    class Slow:
+        def work(self, seconds):
+            time.sleep(seconds)
+            return "done"
+
+    a = Slow.remote()
+    first = a.work.remote(6.0)
+    time.sleep(1.0)  # first call occupies the single-concurrency actor
+    queued = a.work.remote(0.1)
+    ray_tpu.cancel(queued)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=60)
+    # the actor itself is unharmed and finishes the first call
+    assert ray_tpu.get(first, timeout=60) == "done"
+    ray_tpu.kill(a)
+
+
+def test_cancel_running_async_actor_task(cluster):
+    import asyncio as aio
+
+    @ray_tpu.remote(num_cpus=0.5)
+    class AsyncActor:
+        async def sleepy(self, seconds):
+            await aio.sleep(seconds)
+            return "slept"
+
+        async def quick(self):
+            return "quick"
+
+    a = AsyncActor.remote()
+    assert ray_tpu.get(a.quick.remote(), timeout=60) == "quick"
+    ref = a.sleepy.remote(60.0)
+    time.sleep(1.5)  # in flight
+    t0 = time.monotonic()
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=60)
+    assert time.monotonic() - t0 < 30.0
+    # actor survives and serves further calls
+    assert ray_tpu.get(a.quick.remote(), timeout=60) == "quick"
+    ray_tpu.kill(a)
+
+
+def test_cancel_actor_task_force_refused(cluster):
+    @ray_tpu.remote(num_cpus=0.5)
+    class A:
+        def m(self):
+            time.sleep(5.0)
+            return 1
+
+    a = A.remote()
+    ref = a.m.remote()
+    with pytest.raises(ValueError, match="force=True is not supported"):
+        ray_tpu.cancel(ref, force=True)
+    assert ray_tpu.get(ref, timeout=60) == 1
+    ray_tpu.kill(a)

@@ -103,8 +103,13 @@ def test_xla_group_single_process():
     out = group.allreduce(x, ReduceOp.MAX)
     np.testing.assert_allclose(np.asarray(out), np.full((8,), 7.0))
 
-    rs = group.reducescatter(np.ones((8,), np.float32))
+    # axis-0 chunks are per-member contributions (same convention as the
+    # sibling ops): 8 members each contribute ones(8); member i receives
+    # element i of the summed chunk
+    rs = group.reducescatter(np.ones((64,), np.float32))
     np.testing.assert_allclose(np.asarray(rs), np.full((8,), 8.0))
+    with np.testing.assert_raises(ValueError):
+        group.reducescatter(np.ones((8,), np.float32))
 
     bc = group.broadcast(np.arange(8, dtype=np.float32), src_rank=3)
     np.testing.assert_allclose(np.asarray(bc), np.full((8,), 3.0))
@@ -153,7 +158,7 @@ def test_xla_group_multi_worker_spmd():
                 avg = np.asarray(col.allreduce(grad, op=ReduceOp.AVERAGE,
                                                group_name="spmd"))
                 rs = np.asarray(col.reducescatter(
-                    np.ones((8,), np.float32), group_name="spmd"))
+                    np.ones((64,), np.float32), group_name="spmd"))
                 ag = np.asarray(col.allgather(
                     np.full((8,), float(3), np.float32), group_name="spmd"))
                 return avg.tolist(), rs.tolist(), ag.shape
