@@ -20,6 +20,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import pickle
+
+from ray_tpu._private import wire
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -177,9 +179,9 @@ class GcsServer:
         before DoStart (gcs_server.cc:212)."""
         for key, blob in self.store.all("kv").items():
             ns, _, k = key.partition("\x00")
-            self.kv[(ns, k)] = pickle.loads(blob)
+            self.kv[(ns, k)] = wire.loads(blob)
         for key, blob in self.store.all("nodes").items():
-            info: NodeInfo = pickle.loads(blob)
+            info: NodeInfo = wire.loads(blob)
             self.nodes[info.node_id] = info
             if info.alive:
                 self.node_available[info.node_id] = dict(info.total_resources)
@@ -187,19 +189,19 @@ class GcsServer:
                 self.node_last_seen[info.node_id] = time.monotonic()
                 self.node_clients[info.node_id] = RetryingRpcClient(info.address)
         for key, blob in self.store.all("actors").items():
-            record = ActorRecord.restore(pickle.loads(blob))
+            record = ActorRecord.restore(wire.loads(blob))
             self.actors[record.actor_id] = record
             if record.name and record.state != "DEAD":
                 self.named_actors[(record.namespace, record.name)] = record.actor_id
         for key, blob in self.store.all("pgs").items():
-            pg = PGRecord.restore(pickle.loads(blob))
+            pg = PGRecord.restore(wire.loads(blob))
             self.pgs[pg.spec.pg_id] = pg
         for key, blob in self.store.all("jobs").items():
-            job = pickle.loads(blob)
+            job = wire.loads(blob)
             self.jobs[JobID.from_hex(job["job_id"])] = job
         counter = self.store.get("meta", "job_counter")
         if counter is not None:
-            self.job_counter = pickle.loads(counter)
+            self.job_counter = wire.loads(counter)
         if self.actors or self.nodes:
             logger.info(
                 "GCS init data replayed: %d nodes, %d actors, %d pgs, %d jobs, %d kv",
@@ -211,13 +213,13 @@ class GcsServer:
         if delete:
             self.store.delete("kv", skey)
         else:
-            self.store.put("kv", skey, pickle.dumps(value))
+            self.store.put("kv", skey, wire.dumps(value))
 
     def _persist_node(self, info: NodeInfo):
         if not info.alive:
             self.store.delete("nodes", info.node_id.hex())
         else:
-            self.store.put("nodes", info.node_id.hex(), pickle.dumps(info))
+            self.store.put("nodes", info.node_id.hex(), wire.dumps(info))
 
     def _persist_actor(self, record: ActorRecord):
         if record.state == "DEAD":
@@ -226,19 +228,19 @@ class GcsServer:
             self.store.delete("actors", record.actor_id.hex())
         else:
             self.store.put("actors", record.actor_id.hex(),
-                           pickle.dumps(record.dump()))
+                           wire.dumps(record.dump()))
 
     def _persist_pg(self, pg: PGRecord):
         if pg.state == "REMOVED":
             self.store.delete("pgs", pg.spec.pg_id.hex())
         else:
-            self.store.put("pgs", pg.spec.pg_id.hex(), pickle.dumps(pg.dump()))
+            self.store.put("pgs", pg.spec.pg_id.hex(), wire.dumps(pg.dump()))
 
     def _persist_job(self, job: dict):
         if job["state"] == "FINISHED":
             self.store.delete("jobs", job["job_id"])
         else:
-            self.store.put("jobs", job["job_id"], pickle.dumps(job))
+            self.store.put("jobs", job["job_id"], wire.dumps(job))
 
     async def start(self) -> str:
         addr = await self.server.start()
@@ -275,12 +277,12 @@ class GcsServer:
         fn = getattr(self, f"_rpc_{method}", None)
         if fn is None:
             raise RpcError(f"GCS: unknown method {method}")
-        req = pickle.loads(payload) if payload else {}
+        req = wire.loads(payload) if payload else {}
         resp = await fn(req, conn)
-        return pickle.dumps(resp)
+        return wire.dumps(resp)
 
     def _publish(self, channel: str, message: dict):
-        payload = pickle.dumps(message)
+        payload = wire.dumps(message)
         for conn, channels in list(self.subs.values()):
             if channel in channels:
                 asyncio.ensure_future(conn.push(channel, payload))
@@ -455,7 +457,7 @@ class GcsServer:
             "entrypoint": req.get("entrypoint", ""),
         }
         self.conn_jobs[conn.conn_id] = job_id
-        self.store.put("meta", "job_counter", pickle.dumps(self.job_counter))
+        self.store.put("meta", "job_counter", wire.dumps(self.job_counter))
         self._persist_job(self.jobs[job_id])
         return {"job_id": job_id.binary()}
 
@@ -541,13 +543,19 @@ class GcsServer:
     async def _rpc_ObjectLocAdd(self, req, conn):
         node_id = req["node_id"]
         attempt = req.get("attempt", 0)
+        sizes = req.get("sizes") or {}
         for oid in req["oids"]:
+            size = sizes.get(oid, 0)
             entry = self.object_dir.get(oid)
+            if entry is not None and size:
+                entry["size"] = size
             if entry is None:
-                self.object_dir[oid] = {"attempt": attempt, "nodes": {node_id}}
+                self.object_dir[oid] = {"attempt": attempt, "nodes": {node_id},
+                                        "size": size}
             elif attempt > entry["attempt"]:
                 displaced = entry["nodes"] - {node_id}
-                self.object_dir[oid] = {"attempt": attempt, "nodes": {node_id}}
+                self.object_dir[oid] = {"attempt": attempt, "nodes": {node_id},
+                                        "size": size or entry.get("size", 0)}
                 if displaced:
                     asyncio.ensure_future(
                         self._delete_stale_copies(oid, attempt, displaced))
@@ -566,7 +574,7 @@ class GcsServer:
             if client is None or info is None or not info.alive:
                 continue
             try:
-                await client.call("StoreDeleteStale", pickle.dumps(
+                await client.call("StoreDeleteStale", wire.dumps(
                     {"oid": oid, "attempt": attempt}), timeout=10.0, retries=1)
             except (RpcError, asyncio.TimeoutError, OSError):
                 pass
@@ -616,7 +624,7 @@ class GcsServer:
             if client is None or info is None or not info.alive:
                 continue
             try:
-                await client.call("StoreDelete", pickle.dumps({"oids": oids}),
+                await client.call("StoreDelete", wire.dumps({"oids": oids}),
                                   timeout=10.0, retries=1)
             except (RpcError, asyncio.TimeoutError, OSError):
                 pass
@@ -629,7 +637,8 @@ class GcsServer:
             info = self.nodes.get(node_id)
             if info is not None and info.alive:
                 out.append({"node_id": node_id.hex(), "address": info.address})
-        return {"locations": out, "attempt": entry["attempt"] if entry else 0}
+        return {"locations": out, "attempt": entry["attempt"] if entry else 0,
+                "size": entry.get("size", 0) if entry else 0}
 
     # ------------------------------------------------------------------
     # scheduling helpers
@@ -776,7 +785,7 @@ class GcsServer:
                 continue
             try:
                 client = self.node_clients[node_id]
-                reply = pickle.loads(await client.call("RequestWorkerLease", pickle.dumps({
+                reply = wire.loads(await client.call("RequestWorkerLease", wire.dumps({
                     "resources": resources,
                     "label_selector": opts.label_selector,
                     "job_id": spec.job_id,
@@ -797,8 +806,8 @@ class GcsServer:
                 record.node_id = node_id
                 record.lease_id = reply.get("lease_id", "")
                 self._persist_actor(record)
-                wreply = pickle.loads(await self._worker_client(worker_addr).call(
-                    "PushTask", pickle.dumps({"spec": spec}), timeout=600.0))
+                wreply = wire.loads(await self._worker_client(worker_addr).call(
+                    "PushTask", wire.dumps({"spec": spec}), timeout=600.0))
                 if wreply.get("status") != "ok":
                     logger.warning("actor %s creation failed on %s: %s",
                                    record.actor_id.hex()[:8], worker_addr,
@@ -826,8 +835,8 @@ class GcsServer:
         ALIVE; otherwise release the orphaned lease and reschedule."""
         addr = record.address
         try:
-            reply = pickle.loads(await self._worker_client(addr).call(
-                "CheckActor", pickle.dumps({"actor_id": record.actor_id.binary()}),
+            reply = wire.loads(await self._worker_client(addr).call(
+                "CheckActor", wire.dumps({"actor_id": record.actor_id.binary()}),
                 timeout=10.0, retries=1, connect_timeout=2.0, presend_retries=1))
             if reply.get("hosting"):
                 record.state = "ALIVE"
@@ -842,7 +851,7 @@ class GcsServer:
         if record.lease_id and record.node_id in self.node_clients:
             try:
                 await self.node_clients[record.node_id].call(
-                    "ReturnWorkerLease", pickle.dumps({"lease_id": record.lease_id}),
+                    "ReturnWorkerLease", wire.dumps({"lease_id": record.lease_id}),
                     timeout=5.0, retries=1)
             except (RpcError, asyncio.TimeoutError, OSError):
                 pass
@@ -958,7 +967,7 @@ class GcsServer:
                 # connect/presend retry budget per kill (a group shutdown
                 # after node loss kills many actors back-to-back)
                 await self.node_clients[record.node_id].call(
-                    "KillWorker", pickle.dumps({"worker_address": address}),
+                    "KillWorker", wire.dumps({"worker_address": address}),
                     timeout=10.0, retries=0, connect_timeout=2.0,
                     presend_retries=0)
             except (RpcError, asyncio.TimeoutError, OSError):
@@ -1048,7 +1057,7 @@ class GcsServer:
                 # one retry for LIVE nodes (a swallowed transient failure
                 # would leak the bundle reservation until raylet restart);
                 # dead raylets still fail fast via the 2s connect bound
-                await self.node_clients[node_id].call("ReleasePGBundles", pickle.dumps(
+                await self.node_clients[node_id].call("ReleasePGBundles", wire.dumps(
                     {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0,
                     retries=1, connect_timeout=2.0, presend_retries=0)
             except (RpcError, asyncio.TimeoutError, OSError):
@@ -1142,8 +1151,8 @@ class GcsServer:
             ok = True
             for nid, idxs in per_node.items():
                 try:
-                    reply = pickle.loads(await self.node_clients[nid].call(
-                        "PreparePGBundles", pickle.dumps({
+                    reply = wire.loads(await self.node_clients[nid].call(
+                        "PreparePGBundles", wire.dumps({
                             "pg_id": pg.spec.pg_id.binary(),
                             "bundles": {i: pg.spec.bundles[i].resources for i in idxs},
                         }), timeout=10.0))
@@ -1160,7 +1169,7 @@ class GcsServer:
                 # raylet (releasing an unprepared pg is a no-op)
                 for nid in per_node:
                     try:
-                        await self.node_clients[nid].call("ReleasePGBundles", pickle.dumps(
+                        await self.node_clients[nid].call("ReleasePGBundles", wire.dumps(
                             {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0, retries=1)
                     except (RpcError, asyncio.TimeoutError, OSError):
                         pass
@@ -1168,7 +1177,7 @@ class GcsServer:
                 continue
             for nid in per_node:
                 try:
-                    await self.node_clients[nid].call("CommitPGBundles", pickle.dumps(
+                    await self.node_clients[nid].call("CommitPGBundles", wire.dumps(
                         {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0)
                 except (RpcError, asyncio.TimeoutError, OSError):
                     pass

@@ -7,7 +7,11 @@ automatic reconnect + retry with exponential backoff
 like the reference's long-poll subscriber), and config-driven chaos injection
 (``rpc/rpc_chaos.h``) so failure-handling paths are testable from day one.
 
-Payloads are opaque bytes; callers pickle/unpickle (see serialization.py).
+Payloads are opaque bytes; control-plane callers encode them with the typed
+wire schema (wire.py) — never pickle. Every frame carries the wire protocol
+version; frames missing it or carrying a different version are rejected
+before the payload is touched (reference: protobuf schema versioning in
+``src/ray/protobuf/``).
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ from typing import Awaitable, Callable, Dict, Optional, Tuple
 import msgpack
 
 from ray_tpu._private.config import RAY_CONFIG
+from ray_tpu._private.wire import WIRE_VERSION
 
 logger = logging.getLogger(__name__)
 
@@ -32,6 +37,10 @@ _MAX_FRAME = 1 << 31
 
 class RpcError(Exception):
     pass
+
+
+class RpcVersionError(RpcError):
+    """Peer spoke a missing or different wire protocol version."""
 
 
 class RpcConnectionError(RpcError):
@@ -96,11 +105,20 @@ async def _read_frame(reader: asyncio.StreamReader):
     if length > _MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
     body = await reader.readexactly(length)
-    return msgpack.unpackb(body, raw=False, use_list=True)
+    try:
+        parts = msgpack.unpackb(body, raw=False, use_list=True)
+    except Exception as e:
+        raise RpcVersionError(f"unparseable frame (not wire msgpack): {e}")
+    if not isinstance(parts, list) or len(parts) != 5 or parts[0] != WIRE_VERSION:
+        got = parts[0] if isinstance(parts, list) and parts else "<none>"
+        raise RpcVersionError(
+            f"frame wire version {got!r} != {WIRE_VERSION} — peer is "
+            f"unversioned or from an incompatible release")
+    return parts[1:]
 
 
 def _write_frame(writer: asyncio.StreamWriter, parts) -> None:
-    body = msgpack.packb(parts, use_bin_type=True)
+    body = msgpack.packb([WIRE_VERSION, *parts], use_bin_type=True)
     writer.write(len(body).to_bytes(4, "big") + body)
 
 
@@ -188,6 +206,8 @@ class RpcServer:
                     asyncio.ensure_future(self._dispatch(conn, None, method, payload))
                 elif kind == _REQUEST:
                     asyncio.ensure_future(self._dispatch(conn, msg_id, method, payload))
+        except RpcVersionError as e:
+            logger.warning("dropping %s: %s", conn.peer, e)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -296,6 +316,8 @@ class RpcClient:
                             fut.set_result(payload)
                         else:
                             fut.set_exception(RpcApplicationError(payload.decode()))
+        except RpcVersionError as e:
+            self._fail_pending(e)
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
             self._fail_pending(RpcConnectionError(f"connection to {self.address} lost: {e}"))
         except asyncio.CancelledError:

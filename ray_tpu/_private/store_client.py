@@ -9,8 +9,8 @@ no Redis, so the durable flavor is an append-only journal with snapshot
 compaction on open (same recovery semantics: replay-in-order, last write
 wins).
 
-Record format (journal): 4-byte big-endian length + pickled
-``(op, table, key, value)`` tuple, fsync'd per batch. Corrupt/short tails
+Record format (journal): 4-byte big-endian length + wire-msgpack
+``[op, table, key, value]`` record (typed schema, wire.py), fsync'd per batch. Corrupt/short tails
 (crash mid-write) are truncated on load.
 """
 
@@ -18,10 +18,11 @@ from __future__ import annotations
 
 import logging
 import os
-import pickle
 import threading
 import time
 from typing import Dict, Iterable, Optional, Tuple
+
+from ray_tpu._private import wire
 
 logger = logging.getLogger("ray_tpu.store")
 
@@ -76,6 +77,10 @@ class FileStoreClient(StoreClient):
 
     SNAPSHOT = "snapshot.db"
     JOURNAL = "journal.db"
+    # first bytes of every journal; a journal without it (older/other
+    # format) is preserved as .incompat and reported, never silently
+    # truncated to nothing
+    MAGIC = b"RTPUJ1\n"
     # compact when the journal holds this many records beyond the snapshot
     COMPACT_EVERY = 50_000
 
@@ -93,7 +98,12 @@ class FileStoreClient(StoreClient):
         self._journal_records = 0
         self._last_fsync = 0.0
         self._load()
-        self._journal = open(os.path.join(self.dir, self.JOURNAL), "ab")
+        jpath = os.path.join(self.dir, self.JOURNAL)
+        fresh = not os.path.exists(jpath) or os.path.getsize(jpath) == 0
+        self._journal = open(jpath, "ab")
+        if fresh:
+            self._journal.write(self.MAGIC)
+            self._journal.flush()
 
     # -- recovery ------------------------------------------------------
 
@@ -102,7 +112,7 @@ class FileStoreClient(StoreClient):
         if os.path.exists(snap):
             try:
                 with open(snap, "rb") as f:
-                    self._tables = pickle.load(f)
+                    self._tables = wire.loads(f.read())
             except Exception:
                 corrupt = snap + ".corrupt"
                 logger.error(
@@ -124,6 +134,21 @@ class FileStoreClient(StoreClient):
             return
         good = 0
         with open(path, "rb") as f:
+            head = f.read(len(self.MAGIC))
+            if head != self.MAGIC:
+                if head:  # non-empty journal in an unknown/older format
+                    incompat = path + ".incompat"
+                    logger.error(
+                        "GCS journal %s lacks the %r header (older or "
+                        "foreign format) — refusing to replay or truncate "
+                        "it; saved as %s. Durable state from that journal "
+                        "is NOT loaded.", path, self.MAGIC, incompat)
+                    try:
+                        os.replace(path, incompat)
+                    except OSError:
+                        pass
+                return
+            good = f.tell()
             while True:
                 header = f.read(4)
                 if len(header) < 4:
@@ -133,7 +158,7 @@ class FileStoreClient(StoreClient):
                 if len(body) < length:
                     break
                 try:
-                    yield pickle.loads(body)
+                    yield wire.loads(body)
                 except Exception:
                     break
                 good = f.tell()
@@ -153,7 +178,7 @@ class FileStoreClient(StoreClient):
     # -- journal -------------------------------------------------------
 
     def _append(self, op, table, key, value):
-        body = pickle.dumps((op, table, key, value), protocol=pickle.HIGHEST_PROTOCOL)
+        body = wire.dumps([op, table, key, value])
         self._journal.write(len(body).to_bytes(4, "big") + body)
         self._journal.flush()
         now = time.monotonic()
@@ -168,12 +193,14 @@ class FileStoreClient(StoreClient):
         snap = os.path.join(self.dir, self.SNAPSHOT)
         tmp = snap + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(self._tables, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(wire.dumps(self._tables))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, snap)
         self._journal.close()
         self._journal = open(os.path.join(self.dir, self.JOURNAL), "wb")
+        self._journal.write(self.MAGIC)
+        self._journal.flush()
         self._journal_records = 0
 
     # -- StoreClient ---------------------------------------------------

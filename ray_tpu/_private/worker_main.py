@@ -8,7 +8,8 @@ PushTask until killed.
 from __future__ import annotations
 
 import argparse
-import pickle
+
+from ray_tpu._private import wire
 import signal
 import threading
 import time
@@ -68,7 +69,7 @@ def main():
 
     import os
 
-    core._run(core.raylet.call("RegisterWorker", pickle.dumps({
+    core._run(core.raylet.call("RegisterWorker", wire.dumps({
         "pid": os.getpid(), "address": core.address})))
 
     stop = threading.Event()

@@ -11,7 +11,7 @@ whole slices (queued-resources semantics)."""
 from __future__ import annotations
 
 import logging
-import pickle
+from ray_tpu._private import wire
 import threading
 import time
 from typing import Dict, List, Optional
@@ -49,8 +49,8 @@ class Autoscaler:
         async def _call():
             client = RetryingRpcClient(self.gcs_address)
             try:
-                return pickle.loads(
-                    await client.call(method, pickle.dumps(req), timeout=10.0))
+                return wire.loads(
+                    await client.call(method, wire.dumps(req), timeout=10.0))
             finally:
                 await client.close()
 
@@ -132,7 +132,7 @@ class Autoscaler:
         try:
             reply = self._gcs("KVGet", {"ns": "autoscaler", "key": "request_resources"})
             blob = reply.get("value")
-            return pickle.loads(blob) if blob else []
+            return wire.loads(blob) if blob else []
         except Exception:
             return []
 

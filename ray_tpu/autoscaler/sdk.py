@@ -3,7 +3,7 @@ python/ray/autoscaler/sdk.py ``request_resources``)."""
 
 from __future__ import annotations
 
-import pickle
+from ray_tpu._private import wire
 from typing import Dict, List, Optional
 
 
@@ -22,4 +22,4 @@ def request_resources(num_cpus: Optional[int] = None,
     core = global_worker()
     core._run(core._gcs_call("KVPut", {
         "ns": "autoscaler", "key": "request_resources",
-        "value": pickle.dumps(shapes)}))
+        "value": wire.dumps(shapes)}))

@@ -10,7 +10,6 @@ cluster.
 
 from __future__ import annotations
 
-import pickle
 import subprocess
 import time
 from typing import Dict, List, Optional

@@ -20,6 +20,7 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from ray_tpu._private import wire
 from ray_tpu.collective.types import ReduceOp
 
 _STORE_PREFIX = "rtpu_collective_store:"
@@ -53,35 +54,49 @@ class CollectiveStore:
         self._contrib = {}
         self._results = {}
         self._p2p = {}
+        self._events = {}  # key -> asyncio.Event (result ready)
+        self._p2p_events = {}
+
+    def _event(self, table: dict, key: str):
+        import asyncio
+
+        ev = table.get(key)
+        if ev is None:
+            ev = table[key] = asyncio.Event()
+        return ev
 
     async def collect(self, key: str, rank: int, payload, op_name: Optional[str]):
         import asyncio
 
         slot = self._contrib.setdefault(key, {})
         slot[rank] = payload
+        ev = self._event(self._events, key)
         if len(slot) == self.world_size and key not in self._results:
             ordered = [slot[r] for r in range(self.world_size)]
             if op_name is None:
                 self._results[key] = ordered  # allgather
             else:
                 self._results[key] = _reduce_np(ordered, ReduceOp(op_name))
-        deadline = time.monotonic() + 300.0
-        while key not in self._results:
-            if time.monotonic() > deadline:
+            ev.set()  # wake every parked member — no polling
+        if key not in self._results:
+            try:
+                await asyncio.wait_for(ev.wait(), 300.0)
+            except asyncio.TimeoutError:
                 raise TimeoutError(f"collective {key} timed out "
                                    f"({len(slot)}/{self.world_size} arrived)")
-            await asyncio.sleep(0.002)
         result = self._results[key]
         # last leaver cleans up
         slot[f"done{rank}"] = True
         if sum(1 for k in slot if isinstance(k, str)) == self.world_size:
             self._contrib.pop(key, None)
+            self._events.pop(key, None)
             res = self._results.pop(key)
             return res
         return result
 
     async def put_p2p(self, key: str, payload):
         self._p2p[key] = payload
+        self._event(self._p2p_events, key).set()
         return True
 
     async def del_p2p(self, key: str):
@@ -93,10 +108,18 @@ class CollectiveStore:
 
         deadline = time.monotonic() + timeout
         while key not in self._p2p:
-            if time.monotonic() > deadline:
+            ev = self._event(self._p2p_events, key)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 raise TimeoutError(f"p2p {key} timed out")
-            await asyncio.sleep(0.002)
-        return self._p2p.pop(key) if consume else self._p2p[key]
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                raise TimeoutError(f"p2p {key} timed out")
+        if consume:
+            self._p2p_events.pop(key, None)
+            return self._p2p.pop(key)
+        return self._p2p[key]
 
     async def peek(self, key: str, timeout: float = 300.0):
         """Non-consuming wait (rendezvous metadata, e.g. rank addresses)."""
@@ -424,8 +447,8 @@ class XlaGroup:
                     timeout=timeout + 10)
         w = st["worker"]
         client = w._worker_client(addr)
-        reply = _pickle.loads(w._run(client.call(
-            "GetDeviceObject", _pickle.dumps({"oid": key}),
+        reply = wire.loads(w._run(client.call(
+            "GetDeviceObject", wire.dumps({"oid": key}),
             timeout=60.0, retries=1), 70.0))
         if reply["status"] != "ok":
             raise RuntimeError(
@@ -433,7 +456,7 @@ class XlaGroup:
                 f"(sender restarted?)")
         # consume-once: release the sender's device-store slot
         w._run(client.call("FreeDeviceObject",
-                           _pickle.dumps({"oid": key}), timeout=10.0,
+                           wire.dumps({"oid": key}), timeout=10.0,
                            retries=1), 20.0)
         inband, buffers = read_blob(reply["blob"])
         return jnp.asarray(deserialize(inband, buffers))

@@ -190,7 +190,7 @@ class CrossHostWriter:
 
     def write(self, value: Any, timeout: Optional[float] = 300.0):
         import asyncio
-        import pickle as _p
+        from ray_tpu._private import wire as _p
 
         blob = dumps_oob(value)
         t = timeout or 300.0

@@ -24,6 +24,7 @@ Usage::
 
 from __future__ import annotations
 
+from ray_tpu._private import wire
 from typing import Any, Optional
 
 
@@ -62,7 +63,7 @@ def free(ref) -> bool:
 
     async def _free():
         reply = await core._worker_client(marker.address).call(
-            "FreeDeviceObject", pickle.dumps({"oid": marker.oid}), timeout=30.0)
-        return pickle.loads(reply)["freed"]
+            "FreeDeviceObject", wire.dumps({"oid": marker.oid}), timeout=30.0)
+        return wire.loads(reply)["freed"]
 
     return core._run(_free())

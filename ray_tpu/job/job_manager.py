@@ -1,7 +1,7 @@
 """Job manager: supervisor actor per job + KV-backed status/log store.
 
 Reference: dashboard/modules/job/{job_manager.py,job_supervisor.py,sdk.py}.
-KV schema (GCS): ns="job" key=<submission_id> -> pickled info dict;
+KV schema (GCS): ns="job" key=<submission_id> -> wire-encoded info dict;
 ns="job_logs" key=<submission_id> -> utf-8 log bytes (flushed periodically
 and at exit by the supervisor).
 """
@@ -9,7 +9,7 @@ and at exit by the supervisor).
 from __future__ import annotations
 
 import os
-import pickle
+from ray_tpu._private import wire
 import subprocess
 import time
 import uuid
@@ -37,12 +37,12 @@ def _kv_call(method: str, req: dict):
 
 def _job_put(submission_id: str, info: dict):
     _kv_call("KVPut", {"ns": "job", "key": submission_id,
-                       "value": pickle.dumps(info)})
+                       "value": wire.dumps(info)})
 
 
 def _job_get(submission_id: str) -> Optional[dict]:
     blob = _kv_call("KVGet", {"ns": "job", "key": submission_id})["value"]
-    return pickle.loads(blob) if blob is not None else None
+    return wire.loads(blob) if blob is not None else None
 
 
 @ray_tpu.remote(num_cpus=0.1, max_restarts=0)

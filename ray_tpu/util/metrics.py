@@ -111,7 +111,7 @@ def scrape_metrics() -> Dict[str, dict]:
 def publish_metrics():
     """Push this process's metrics to the GCS KV (metrics namespace)."""
     import os
-    import pickle
+    from ray_tpu._private import wire
 
     from ray_tpu._private import worker as worker_mod
 
@@ -119,4 +119,4 @@ def publish_metrics():
     payload = {"pid": os.getpid(), "time": time.time(), "metrics": scrape_metrics()}
     core._run(core._gcs_call("KVPut", {
         "ns": "metrics", "key": f"proc_{os.getpid()}",
-        "value": pickle.dumps(payload)}))
+        "value": wire.dumps(payload)}))

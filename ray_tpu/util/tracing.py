@@ -22,7 +22,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
-import pickle
+from ray_tpu._private import wire
 import threading
 import time
 import uuid
@@ -133,7 +133,7 @@ def flush():
             _local_spans.extend(spans)
             return
         req = {"ns": "trace", "key": f"spans_{_proc_tag}_{counter}",
-               "value": pickle.dumps(spans)}
+               "value": wire.dumps(spans)}
 
         async def _put_guarded():
             try:
@@ -176,7 +176,7 @@ def get_spans() -> List[dict]:
         blob = core._run(core._gcs_call(
             "KVGet", {"ns": "trace", "key": key}))["value"]
         if blob:
-            out.extend(pickle.loads(blob))
+            out.extend(wire.loads(blob))
     return sorted(out, key=lambda s: s["ts"])
 
 

@@ -16,6 +16,7 @@ import time
 import numpy as np
 import pytest
 
+from ray_tpu._private import wire
 import ray_tpu
 from ray_tpu._private.object_store import ObjectStoreServer
 
@@ -200,8 +201,8 @@ def _rpc(address, method, req, timeout=30.0):
     async def go():
         client = RetryingRpcClient(address)
         try:
-            return pickle.loads(await client.call(
-                method, pickle.dumps(req), timeout=timeout))
+            return wire.loads(await client.call(
+                method, wire.dumps(req), timeout=timeout))
         finally:
             await client.close()
 

@@ -15,6 +15,7 @@ import time
 import numpy as np
 import pytest
 
+from ray_tpu._private import wire
 import ray_tpu
 
 
@@ -28,7 +29,7 @@ def cluster():
 
 def _store_objects():
     w = ray_tpu._private.worker.global_worker()
-    return pickle.loads(w._run(w.raylet.call("StoreStats", b"")))["num_objects"]
+    return wire.loads(w._run(w.raylet.call("StoreStats", b"")))["num_objects"]
 
 
 def _wait_store_below(n, timeout=15.0):

@@ -7,6 +7,7 @@ import time
 
 import pytest
 
+from ray_tpu._private import wire
 import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
@@ -67,7 +68,7 @@ def test_raylet_view_tracks_membership(two_node):
     w = ray_tpu._private.worker.global_worker()
 
     def view():
-        return pickle.loads(w._run(w.raylet.call("GetNodeStats", b"")))
+        return wire.loads(w._run(w.raylet.call("GetNodeStats", b"")))
 
     stats = view()
     assert stats.get("cluster_view_size", 0) >= 2

@@ -1,0 +1,157 @@
+"""Typed wire schema + versioned framing (reference: src/ray/protobuf/*.proto).
+
+The control plane must never unpickle network input: payloads are strict
+msgpack over an explicit struct registry, and every frame carries the wire
+protocol version.
+"""
+
+import asyncio
+import os
+import pickle
+
+import pytest
+
+from ray_tpu._private import wire
+from ray_tpu._private.common import (Bundle, NodeInfo, PlacementGroupSpec,
+                                     TaskOptions, TaskSpec)
+from ray_tpu._private.ids import JobID, NodeID, ObjectID, PlacementGroupID, TaskID
+from ray_tpu._private.rpc import RpcServer, RpcClient, RpcVersionError
+
+
+def test_struct_roundtrip():
+    jid = JobID.from_int(7)
+    spec = TaskSpec(
+        task_id=TaskID.of(jid), job_id=jid, function_key="fn",
+        args_blob=b"\x00blob", num_returns=2,
+        options=TaskOptions(num_cpus=2.0, resources={"TPU": 1.0},
+                            label_selector={"k": "v"}))
+    pg = PlacementGroupSpec(
+        pg_id=PlacementGroupID.from_random(),
+        bundles=[Bundle(resources={"CPU": 1.0})], strategy="SPREAD")
+    node = NodeInfo(node_id=NodeID.from_random(), address="a:1",
+                    object_store_address="b:2", total_resources={"CPU": 4.0})
+    msg = {"spec": spec, "pg": pg, "node": node,
+           "oids": [ObjectID.for_task_return(spec.task_id, 0)],
+           "seen": {1, 2, 3}, "blob": b"raw", "n": None}
+    out = wire.loads(wire.dumps(msg))
+    assert out["spec"] == spec
+    assert out["pg"] == pg
+    assert out["node"] == node
+    assert out["oids"][0] == ObjectID.for_task_return(spec.task_id, 0)
+    assert out["seen"] == {1, 2, 3}
+    assert out["blob"] == b"raw" and out["n"] is None
+
+
+def test_numpy_roundtrip():
+    import numpy as np
+
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = wire.loads(wire.dumps({"a": a}))
+    assert out["a"].dtype == np.float32 and (out["a"] == a).all()
+
+
+def test_unregistered_type_rejected():
+    class Private:
+        pass
+
+    with pytest.raises(wire.WireError):
+        wire.dumps({"x": Private()})
+
+
+def test_pickle_payload_never_executed(tmp_path):
+    """A pickle blob fed to wire.loads must raise without running its reducer."""
+    marker = tmp_path / "pwned"
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {marker}",))
+
+    blob = pickle.dumps(Evil())
+    with pytest.raises(wire.WireError):
+        wire.loads(blob)
+    assert not marker.exists()
+
+
+def test_forward_compat_unknown_field_dropped():
+    # simulate a newer sender adding a field: decode drops it, keeps the rest
+    import msgpack
+
+    payload = msgpack.packb(
+        ["Bundle", {"resources": {"CPU": 1.0}, "label_selector": {},
+                    "field_from_the_future": 42}], use_bin_type=True)
+    ext = msgpack.ExtType(1, payload)
+    out = wire.loads(msgpack.packb(ext))
+    assert isinstance(out, Bundle) and out.resources == {"CPU": 1.0}
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_unversioned_frame_rejected():
+    """A legacy 4-element (no version) frame drops the connection; a versioned
+    client on the same server still works."""
+
+    async def main():
+        import msgpack
+
+        async def handler(method, payload, conn):
+            return wire.dumps({"ok": True})
+
+        server = RpcServer(handler)
+        addr = await server.start()
+        host, _, port = addr.rpartition(":")
+
+        # raw legacy frame: [msg_id, kind, method, payload] without version
+        reader, writer = await asyncio.open_connection(host, int(port))
+        body = msgpack.packb([1, 0, "Ping", b""], use_bin_type=True)
+        writer.write(len(body).to_bytes(4, "big") + body)
+        await writer.drain()
+        got = await reader.read(1)  # server must close, not answer
+        assert got == b""
+        writer.close()
+
+        # wrong version number is rejected the same way
+        reader, writer = await asyncio.open_connection(host, int(port))
+        body = msgpack.packb([999, 1, 0, "Ping", b""], use_bin_type=True)
+        writer.write(len(body).to_bytes(4, "big") + body)
+        await writer.drain()
+        assert await reader.read(1) == b""
+        writer.close()
+
+        # a real client still round-trips
+        client = await RpcClient(addr).connect()
+        reply = wire.loads(await client.call("Ping", wire.dumps({})))
+        assert reply == {"ok": True}
+        await client.close()
+        await server.stop()
+
+    _run(main())
+
+
+def test_client_rejects_bad_server_version(monkeypatch):
+    """Client-side: a reply frame with the wrong version fails pending calls
+    with RpcVersionError (not a retryable connection error)."""
+
+    async def main():
+        import msgpack
+
+        async def on_client(reader, writer):
+            await reader.read(64)  # swallow the request
+            body = msgpack.packb([999, 1, 1, "", b""], use_bin_type=True)
+            writer.write(len(body).to_bytes(4, "big") + body)
+            await writer.drain()
+
+        server = await asyncio.start_server(on_client, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        client = await RpcClient(f"127.0.0.1:{port}").connect()
+        with pytest.raises(RpcVersionError):
+            await client.call("Ping", b"", timeout=5.0)
+        await client.close()
+        server.close()
+
+    _run(main())
