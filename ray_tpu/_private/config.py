@@ -86,6 +86,10 @@ _d("worker_pool_prestart", 0)
 _d("worker_idle_timeout_s", 300.0)
 _d("max_workers_per_node", 64)
 _d("lease_spillback_max_hops", 4)
+# smallest total argument footprint that makes locality steer lease placement
+_d("locality_min_arg_bytes", 64 * 1024)
+# queued pulls with no remaining waiters are cancelled after this long
+_d("object_pull_interest_ttl_s", 30.0)
 _d("scheduler_spread_threshold", 0.5)  # hybrid policy: pack below, spread above
 _d("worker_start_timeout_s", 60.0)
 # how long a task waits for a feasible node (an autoscaler may add one)

@@ -22,6 +22,8 @@ import hashlib
 import logging
 import os
 import pickle
+
+from ray_tpu._private import wire
 import threading
 import time
 import traceback
@@ -141,6 +143,16 @@ class _LeasePool:
                         record = self.pending.popleft()
                         self.core._complete_error(record, TaskError(
                             f"scheduling failed for {record['name']}: {e}", tb))
+                elif self.pending:
+                    # a sibling lease survives, so queued tasks will drain
+                    # onto it eventually — don't error them, but don't be
+                    # silent either: the shape is currently unschedulable
+                    # anywhere else (reference: infeasible-task warnings in
+                    # cluster_task_manager.cc)
+                    logger.warning(
+                        "cannot acquire another lease for %s (%s); %d queued "
+                        "task(s) remain behind %d existing lease(s)",
+                        self.key, e, len(self.pending), self.active_leases)
                 return
             idle_deadline = None
             self.active_leases += 1
@@ -175,6 +187,17 @@ class _LeasePool:
                     self.busy += 1
                     try:
                         ok = await self._push_batch(lease, batch)
+                    except Exception as e:
+                        # a non-RPC failure (encoding bug, cancelled loop):
+                        # deterministic, so retrying would loop — fail the
+                        # batch loudly instead of stranding its futures
+                        tb = traceback.format_exc()
+                        for record in batch:
+                            self.core._complete_error(record, TaskError(
+                                f"task submission failed for "
+                                f"{record['name']}: {e}", tb))
+                        await self.core._drop_lease(lease)
+                        return
                     finally:
                         self.busy -= 1
                     if not ok:
@@ -201,9 +224,9 @@ class _LeasePool:
             record["epoch"] = record.get("epoch", -1) + 1
             record["spec"].attempt = record["epoch"]
             record["_pushed_to"] = lease["worker_address"]
-        payload = pickle.dumps({"specs": [r["spec"] for r in batch]})
+        payload = wire.dumps({"specs": [r["spec"] for r in batch]})
         try:
-            reply = pickle.loads(await core._worker_client(
+            reply = wire.loads(await core._worker_client(
                 lease["worker_address"]).call(
                     "PushTaskBatch", payload, timeout=86400.0, retries=0))
         except (RpcError, asyncio.TimeoutError, OSError) as e:
@@ -281,9 +304,9 @@ class _LeasePool:
         """After a push failure, ask the granting raylet whether the memory
         monitor killed the worker (surfaces OutOfMemoryError to the user)."""
         try:
-            reply = pickle.loads(await self.core._raylet_client(
+            reply = wire.loads(await self.core._raylet_client(
                 lease["raylet_address"]).call(
-                    "WasWorkerOOM", pickle.dumps(
+                    "WasWorkerOOM", wire.dumps(
                         {"worker_address": lease["worker_address"]}),
                     timeout=5.0, retries=0))
             return bool(reply.get("oom"))
@@ -312,7 +335,17 @@ class _LeasePool:
             "runtime_env": opts.runtime_env,
         }
         if opts.placement_group is None and opts.scheduling_strategy is None:
-            out = await self._request_two_level(req)
+            start_addr = None
+            if self.pending:
+                # locality-aware lease targeting: start the chain at the
+                # raylet holding the most argument bytes, so the task runs
+                # next to its data instead of pulling it (reference:
+                # lease_policy.cc). Spillback tie-breaks on the same map.
+                loc, start_addr = await self.core._arg_locality(
+                    self.pending[0])
+                if loc:
+                    req = dict(req, locality=loc)
+            out = await self._request_two_level(req, start_addr)
             if out != "fallback":
                 return out  # a lease, or None (queue drained: stand down)
             # cluster-wide infeasible / local raylet gone: fall through to
@@ -333,8 +366,8 @@ class _LeasePool:
                 # phantom autoscaler demand for work that no longer exists
                 return None
             try:
-                reply = pickle.loads(await raylet.call(
-                    "RequestWorkerLease", pickle.dumps(req),
+                reply = wire.loads(await raylet.call(
+                    "RequestWorkerLease", wire.dumps(req),
                     timeout=RAY_CONFIG.worker_start_timeout_s + 30,
                     connect_timeout=5.0, retries=1))
             except (RpcError, asyncio.TimeoutError, OSError) as e:
@@ -352,6 +385,9 @@ class _LeasePool:
                     raylet = self.core._raylet_client(node["address"])
                 continue
             unreachable_deadline = None
+            if reply["status"] == "runtime_env_failed":
+                raise RuntimeError(
+                    f"runtime_env setup failed: {reply.get('error', '')}")
             if reply["status"] == "granted":
                 return {"key": self.key, "lease_id": reply["lease_id"],
                         "worker_address": reply["worker_address"],
@@ -418,7 +454,8 @@ class _LeasePool:
             else:
                 busy_delay = 0.1
 
-    async def _request_two_level(self, base_req: dict):
+    async def _request_two_level(self, base_req: dict,
+                                 start_addr: Optional[str] = None):
         """Lease via the local raylet + spillback chain (reference:
         normal_task_submitter going to the lease policy's raylet, raylet
         spillback at cluster_lease_manager.cc:421). Returns a lease dict,
@@ -426,7 +463,7 @@ class _LeasePool:
         cluster has no feasible node / the local raylet is unreachable —
         the caller then uses the GCS path, which records autoscaler demand."""
         core = self.core
-        addr = core.raylet_address
+        addr = start_addr or core.raylet_address
         req = dict(base_req, allow_spillback=True)
         max_hops = RAY_CONFIG.lease_spillback_max_hops
         hops = 0
@@ -436,8 +473,8 @@ class _LeasePool:
             if not self.pending:
                 return None
             try:
-                reply = pickle.loads(await core._raylet_client(addr).call(
-                    "RequestWorkerLease", pickle.dumps(req),
+                reply = wire.loads(await core._raylet_client(addr).call(
+                    "RequestWorkerLease", wire.dumps(req),
                     timeout=RAY_CONFIG.worker_start_timeout_s + 30,
                     connect_timeout=5.0, retries=1))
             except (RpcError, asyncio.TimeoutError, OSError):
@@ -453,6 +490,9 @@ class _LeasePool:
                 continue
             unreachable = 0
             status = reply["status"]
+            if status == "runtime_env_failed":
+                raise RuntimeError(
+                    f"runtime_env setup failed: {reply.get('error', '')}")
             if status == "granted":
                 return {"key": self.key, "lease_id": reply["lease_id"],
                         "worker_address": reply["worker_address"],
@@ -657,7 +697,7 @@ class CoreWorker:
         async def _one(owner, oids):
             try:
                 await self._worker_client(owner).call(
-                    "AddBorrowers", pickle.dumps(
+                    "AddBorrowers", wire.dumps(
                         {"oids": oids, "address": self.address}),
                     timeout=10.0, retries=1)
             except (RpcError, asyncio.TimeoutError, OSError):
@@ -674,7 +714,7 @@ class CoreWorker:
             self.gcs_address, on_push=self._on_push, on_reconnect=self._on_gcs_reconnect
         )
         if self.is_driver:
-            reply = pickle.loads(await self.gcs.call("RegisterDriver", pickle.dumps({
+            reply = wire.loads(await self.gcs.call("RegisterDriver", wire.dumps({
                 "address": self.address,
                 "namespace": self.namespace,
                 "entrypoint": " ".join(os.sys.argv[:2]),
@@ -683,12 +723,12 @@ class CoreWorker:
         channels = ["actors"]
         if self.is_driver and getattr(self, "log_to_driver", False):
             channels.append("logs")
-        await self.gcs.call("Subscribe", pickle.dumps({"channels": channels}))
+        await self.gcs.call("Subscribe", wire.dumps({"channels": channels}))
         if self.raylet_address:
             self.raylet = RetryingRpcClient(self.raylet_address)
         else:
             # pick the head node's raylet as our local raylet
-            nodes = pickle.loads(await self.gcs.call("GetAllNodes", b""))["nodes"]
+            nodes = wire.loads(await self.gcs.call("GetAllNodes", b""))["nodes"]
             head = next((n for n in nodes if n["is_head"]), nodes[0] if nodes else None)
             if head is None:
                 raise RuntimeError("no raylets registered with the GCS")
@@ -701,7 +741,7 @@ class CoreWorker:
             channels = ["actors"]
             if self.is_driver and getattr(self, "log_to_driver", False):
                 channels.append("logs")
-            await client.call("Subscribe", pickle.dumps({"channels": channels}))
+            await client.call("Subscribe", wire.dumps({"channels": channels}))
         except Exception:
             logger.warning("GCS reconnect: re-subscribe failed", exc_info=True)
         if self.is_driver and not self.job_id.is_nil():
@@ -709,7 +749,7 @@ class CoreWorker:
             # driver-disconnect cleanup still fires (GCS FT)
             for _ in range(3):
                 try:
-                    await client.call("ReattachDriver", pickle.dumps(
+                    await client.call("ReattachDriver", wire.dumps(
                         {"job_id": self.job_id.binary()}))
                     break
                 except Exception:
@@ -718,7 +758,7 @@ class CoreWorker:
                     await asyncio.sleep(0.2)
 
     def _on_push(self, channel: str, payload: bytes):
-        msg = pickle.loads(payload)
+        msg = wire.loads(payload)
         if channel == "logs":
             import sys as _sys
 
@@ -761,7 +801,7 @@ class CoreWorker:
         return c
 
     async def _gcs_call(self, method: str, req: dict, timeout=None) -> dict:
-        return pickle.loads(await self.gcs.call(method, pickle.dumps(req), timeout=timeout))
+        return wire.loads(await self.gcs.call(method, wire.dumps(req), timeout=timeout))
 
     # ------------------------------------------------------------------
     # function / class table
@@ -858,7 +898,7 @@ class CoreWorker:
     async def _store_blob(self, oid: ObjectID, inband: bytes, buffers,
                           attempt: int = 0):
         total, offsets = plan_layout(inband, buffers)
-        reply = pickle.loads(await self.raylet.call("StoreCreate", pickle.dumps(
+        reply = wire.loads(await self.raylet.call("StoreCreate", wire.dumps(
             {"oid": oid.binary(), "size": total, "attempt": attempt})))
         if reply["status"] in ("exists", "stale_attempt"):
             # seal-once: the id is already (or about to be) bound to a value
@@ -878,12 +918,14 @@ class CoreWorker:
                 write_blob(seg.buf, inband, buffers, offsets)
             finally:
                 seg.close()
-        await self.raylet.call("StoreSeal", pickle.dumps(
+        await self.raylet.call("StoreSeal", wire.dumps(
             {"oid": oid.binary(), "attempt": attempt}))
 
-    async def _read_local_store(self, oid: ObjectID, timeout: float, pull=True):
-        reply = pickle.loads(await self.raylet.call("StoreGet", pickle.dumps(
-            {"oid": oid.binary(), "timeout": timeout, "pull": pull}),
+    async def _read_local_store(self, oid: ObjectID, timeout: float, pull=True,
+                                prio: int = 0):
+        reply = wire.loads(await self.raylet.call("StoreGet", wire.dumps(
+            {"oid": oid.binary(), "timeout": timeout, "pull": pull,
+             "prio": prio}),
             timeout=timeout + 10.0))
         status = reply["status"]
         if status == "inline":
@@ -901,7 +943,8 @@ class CoreWorker:
             return True, deserialize(inband, buffers)
         return False, None
 
-    async def _get_one(self, ref: ObjectRef, deadline: float) -> Any:
+    async def _get_one(self, ref: ObjectRef, deadline: float,
+                       prio: int = 0) -> Any:
         oid = ref.id
         lost_hint = False
         while True:
@@ -922,7 +965,7 @@ class CoreWorker:
             # 3. known to live in the distributed store
             if self._in_store.get(oid):
                 ok, value = await self._read_local_store(
-                    oid, max(0.1, deadline - time.monotonic()))
+                    oid, max(0.1, deadline - time.monotonic()), prio=prio)
                 if ok:
                     return value
                 # lost from the store (e.g. the holding node died):
@@ -940,7 +983,7 @@ class CoreWorker:
                 lost_hint = False
                 if in_store:
                     ok, value = await self._read_local_store(
-                        oid, max(0.1, deadline - time.monotonic()))
+                        oid, max(0.1, deadline - time.monotonic()), prio=prio)
                     if ok:
                         return value
                     # tell the owner on the next round so it can verify and
@@ -950,7 +993,8 @@ class CoreWorker:
                 return value
             # 5. last resort: the store via directory pull
             ok, value = await self._read_local_store(
-                oid, max(0.1, min(deadline - time.monotonic(), 5.0)))
+                oid, max(0.1, min(deadline - time.monotonic(), 5.0)),
+                prio=prio)
             if ok:
                 return value
             if time.monotonic() > deadline:
@@ -964,7 +1008,7 @@ class CoreWorker:
             if timeout <= 0:
                 raise GetTimeoutError(f"timed out fetching {ref.hex()} from owner")
             try:
-                reply = pickle.loads(await client.call("GetOwnedObject", pickle.dumps(
+                reply = wire.loads(await client.call("GetOwnedObject", wire.dumps(
                     {"oid": ref.binary(), "timeout": min(timeout, 10.0),
                      "lost": lost}),
                     timeout=min(timeout, 10.0) + 5.0, retries=1))
@@ -1003,8 +1047,8 @@ class CoreWorker:
             return cached
         timeout = max(1.0, min(deadline - time.monotonic(), 300.0))
         try:
-            reply = pickle.loads(await self._worker_client(value.address).call(
-                "GetDeviceObject", pickle.dumps({"oid": value.oid}),
+            reply = wire.loads(await self._worker_client(value.address).call(
+                "GetDeviceObject", wire.dumps({"oid": value.oid}),
                 timeout=timeout, retries=1, connect_timeout=5.0))
         except (RpcError, asyncio.TimeoutError) as e:
             raise ObjectLostError(
@@ -1073,7 +1117,7 @@ class CoreWorker:
                         fut_pending, return_when=asyncio.FIRST_COMPLETED)))
                 if store_pending:
                     waiters.append(asyncio.ensure_future(self.raylet.call(
-                        "StoreWaitAny", pickle.dumps({
+                        "StoreWaitAny", wire.dumps({
                             "oids": [r.binary() for r in store_pending],
                             "num_needed": 1, "timeout": chunk}),
                         timeout=chunk + 10.0, retries=0)))
@@ -1143,7 +1187,7 @@ class CoreWorker:
                     await self._gcs_call("ObjectFree", {"oids": freed_in_store})
                 except (RpcError, asyncio.TimeoutError, OSError):
                     pass
-            await self.raylet.call("StoreDelete", pickle.dumps({"oids": oids}))
+            await self.raylet.call("StoreDelete", wire.dumps({"oids": oids}))
 
         self._run(_free())
 
@@ -1212,7 +1256,7 @@ class CoreWorker:
         else:
             try:
                 await self._worker_client(value.address).call(
-                    "FreeDeviceObject", pickle.dumps({"oid": value.oid}),
+                    "FreeDeviceObject", wire.dumps({"oid": value.oid}),
                     timeout=10.0, retries=1)
             except (RpcError, asyncio.TimeoutError, OSError):
                 pass
@@ -1244,7 +1288,7 @@ class CoreWorker:
             if rc.held_count(oid) <= 0 or self._shutdown:
                 return
             try:
-                await self._worker_client(owner).call("AddBorrower", pickle.dumps(
+                await self._worker_client(owner).call("AddBorrower", wire.dumps(
                     {"oid": oid, "address": self.address}),
                     timeout=10.0, retries=1)
                 return
@@ -1282,9 +1326,9 @@ class CoreWorker:
                 if not snap:
                     return
                 try:
-                    reply = pickle.loads(await self._worker_client(addr).call(
+                    reply = wire.loads(await self._worker_client(addr).call(
                         "WaitBorrowsDone",
-                        pickle.dumps({"oids": list(snap)}),
+                        wire.dumps({"oids": list(snap)}),
                         timeout=40.0, retries=0, connect_timeout=5.0))
                     failing_since = None
                     delay = 1.0
@@ -1397,7 +1441,7 @@ class CoreWorker:
 
     async def _forward_borrow(self, owner: str, oid: bytes, borrower: str):
         try:
-            await self._worker_client(owner).call("AddBorrower", pickle.dumps(
+            await self._worker_client(owner).call("AddBorrower", wire.dumps(
                 {"oid": oid, "address": borrower}), timeout=10.0, retries=1)
         except (RpcError, asyncio.TimeoutError, OSError):
             pass
@@ -1621,6 +1665,46 @@ class CoreWorker:
             self._lease_cache[key] = pool
         return pool
 
+    async def _arg_locality(self, record: dict):
+        """Byte-weighted argument locations for a task (reference:
+        task_submission/lease_policy.cc LocalityAwareLeasePolicy): returns
+        ({node_hex: bytes}, best_address) using the GCS object directory
+        (sizes ride the location announcements), briefly cached per oid.
+        None when args are inline/small — locality cannot beat the local
+        start then."""
+        arg_refs = record.get("arg_refs") or ()
+        if not arg_refs:
+            return None, None
+        if not hasattr(self, "_loc_cache"):
+            self._loc_cache = {}
+        by_node: Dict[str, int] = {}
+        addr_of: Dict[str, str] = {}
+        now = time.monotonic()
+        for oid, _owner in arg_refs:
+            key = oid.binary() if hasattr(oid, "binary") else oid
+            hit = self._loc_cache.get(key)
+            if hit is not None and now - hit[0] < 5.0:
+                reply = hit[1]
+            else:
+                try:
+                    reply = await self._gcs_call(
+                        "ObjectLocGet", {"oid": key}, timeout=5.0)
+                except Exception:
+                    continue
+                if len(self._loc_cache) > 4096:
+                    self._loc_cache.clear()
+                self._loc_cache[key] = (now, reply)
+            size = reply.get("size") or 0  # None: deleted-before-announce
+            for loc in reply.get("locations", ()):
+                by_node[loc["node_id"]] = by_node.get(loc["node_id"], 0) + size
+                addr_of[loc["node_id"]] = loc["address"]
+        if not by_node:
+            return None, None
+        best = max(by_node, key=by_node.get)
+        if by_node[best] < RAY_CONFIG.locality_min_arg_bytes:
+            return by_node, None
+        return by_node, addr_of.get(best)
+
     async def _pick_node(self, opts: TaskOptions, resources) -> Optional[dict]:
         strat = opts.scheduling_strategy
         if opts.placement_group is not None:
@@ -1682,7 +1766,7 @@ class CoreWorker:
     async def _drop_lease(self, lease: dict):
         try:
             await self._raylet_client(lease["raylet_address"]).call(
-                "ReturnWorkerLease", pickle.dumps({"lease_id": lease["lease_id"]}),
+                "ReturnWorkerLease", wire.dumps({"lease_id": lease["lease_id"]}),
                 timeout=5.0, retries=1)
         except (RpcError, asyncio.TimeoutError, OSError):
             pass
@@ -1751,21 +1835,30 @@ class CoreWorker:
         """Non-blocking (see submit_task): actor calls pipeline without a
         per-call cross-thread round trip."""
         task_id = TaskID.of(self.job_id)
+        streaming = num_returns == "streaming"
+        nret = 0 if streaming else num_returns
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
-                for i in range(num_returns)]
+                for i in range(nret)]
         args_blob, arg_refs = self._pack_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
             function_key="",
             args_blob=args_blob,
-            num_returns=num_returns,
-            options=TaskOptions(num_returns=num_returns),
+            num_returns=-1 if streaming else nret,
+            options=TaskOptions(num_returns=-1 if streaming else nret),
             owner_address=self.address,
             actor_id=handle.actor_id,
             method_name=method_name,
             tensor_transport=tensor_transport,
         )
+        if streaming:
+            # same owner-side stream state as task generators; the
+            # executor's StreamTaskReturn RPCs fill it (reference: the
+            # dynamic-returns protocol works identically for actor tasks)
+            self._streams[task_id.binary()] = {
+                "produced": 0, "total": None, "error": None,
+                "event": asyncio.Event()}
         record = {"spec": spec, "attempts": 0,
                   "max_retries": handle._max_task_retries,
                   "return_ids": [ref.id for ref in refs],
@@ -1783,7 +1876,11 @@ class CoreWorker:
             asyncio.ensure_future(self._drive_actor_task(view, record))
 
         self._queue_kickoff(_kickoff)
-        return refs[0] if num_returns == 1 else refs
+        if streaming:
+            from ray_tpu.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(self, task_id, self.address)
+        return refs[0] if nret == 1 else refs
 
     async def _drive_actor_task(self, view: _ActorView, record: dict):
         try:
@@ -1838,8 +1935,8 @@ class CoreWorker:
                 # stale — fail fast into the GCS recheck below (the real retry
                 # loop) rather than camping on connect; the single presend
                 # round covers the connect-then-instant-RST race on live peers
-                reply = pickle.loads(await self._worker_client(view.address).call(
-                    "PushTask", pickle.dumps({"spec": spec}), timeout=86400.0,
+                reply = wire.loads(await self._worker_client(view.address).call(
+                    "PushTask", wire.dumps({"spec": spec}), timeout=86400.0,
                     retries=0, connect_timeout=2.0, presend_retries=1))
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 view.state = "UNKNOWN"
@@ -1859,7 +1956,8 @@ class CoreWorker:
                 continue
             if reply["status"] == "ok":
                 self._process_reply_refs(reply, view.address)
-                self._complete_ok(record, reply["results"])
+                self._complete_ok(record, reply["results"],
+                                  stream_count=reply.get("stream_count"))
             else:
                 self._complete_error(record, pickle.loads(reply["error"]))
             return
@@ -2031,7 +2129,7 @@ class CoreWorker:
             if addr:
                 try:
                     await self._worker_client(addr).call(
-                        "CancelTask", pickle.dumps(
+                        "CancelTask", wire.dumps(
                             {"task_id": rec["spec"].task_id.binary(),
                              "force": False}), timeout=10.0, retries=1)
                 except (RpcError, asyncio.TimeoutError, OSError):
@@ -2053,7 +2151,7 @@ class CoreWorker:
         if addr:
             try:
                 await self._worker_client(addr).call(
-                    "CancelTask", pickle.dumps(
+                    "CancelTask", wire.dumps(
                         {"task_id": rec["spec"].task_id.binary(),
                          "force": force}), timeout=10.0, retries=1)
             except (RpcError, asyncio.TimeoutError, OSError):
@@ -2083,10 +2181,10 @@ class CoreWorker:
 
     async def _handle_rpc(self, method: str, payload: bytes, conn) -> bytes:
         if method == "PushTask":
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             return await self._handle_push_task(req["spec"])
         if method == "PushTaskBatch":
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             results = []
             run: List[TaskSpec] = []  # consecutive plain tasks, fused
 
@@ -2102,51 +2200,54 @@ class CoreWorker:
                 else:
                     await _flush_run()
                     results.append(
-                        pickle.loads(await self._handle_push_task(spec)))
+                        wire.loads(await self._handle_push_task(spec)))
             await _flush_run()
-            return pickle.dumps({"results": results})
+            return wire.dumps({"results": results})
         if method == "GetOwnedObject":
-            return await self._handle_get_owned(pickle.loads(payload))
+            return await self._handle_get_owned(wire.loads(payload))
         if method == "AddBorrower":
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             self.ref_counter.add_borrower(req["oid"], req["address"])
             self._watch_borrower(req["oid"], req["address"])
-            return pickle.dumps({"status": "ok"})
+            return wire.dumps({"status": "ok"})
         if method == "AddBorrowers":
             # bulk re-assert from a borrower's periodic sweep
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             for oid in req["oids"]:
                 self.ref_counter.add_borrower(oid, req["address"])
                 self._watch_borrower(oid, req["address"])
-            return pickle.dumps({"status": "ok"})
+            return wire.dumps({"status": "ok"})
         if method == "RemoveBorrower":
             # legacy/no-op-compatible explicit release (owner watches are
             # the primary removal path)
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             self.ref_counter.remove_borrower(req["oid"], req["address"])
-            return pickle.dumps({"status": "ok"})
+            return wire.dumps({"status": "ok"})
         if method == "WaitBorrowsDone":
             # borrower side of the owner's watch: long-poll until any of
             # the probed oids is fully released here
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             deadline = time.monotonic() + 25.0
             while True:
                 self.ref_counter.flush_deletes()
                 done = [o for o in req["oids"]
                         if self.ref_counter.held_count(o) <= 0]
                 if done or self._shutdown or time.monotonic() > deadline:
-                    return pickle.dumps({"done": done})
+                    return wire.dumps({"done": done})
                 await asyncio.sleep(0.2)
         if method == "StreamTaskReturn":
             # executor pushing one streamed yield (reference: the dynamic
             # return objects a generator task reports to its owner)
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             tid_b = req["task_id"]
             rec = self._tasks.get(TaskID(tid_b))
+            if rec is None:
+                # actor streaming records live in the actor-inflight table
+                rec = self._actor_inflight.get(TaskID(tid_b))
             if rec is not None and req.get("attempt", 0) != rec.get("epoch", 0):
                 # zombie attempt: a retry superseded this execution — its
                 # items must not interleave into the current stream
-                return pickle.dumps({"status": "stale_attempt"})
+                return wire.dumps({"status": "stale_attempt"})
             oid = ObjectID.for_task_return(TaskID(tid_b), req["index"])
             if req["kind"] == "inline":
                 inband, buffers = read_blob(req["blob"])
@@ -2166,16 +2267,16 @@ class CoreWorker:
                 st["produced"] = max(st["produced"], req["index"] + 1)
                 ev, st["event"] = st["event"], asyncio.Event()
                 ev.set()
-            return pickle.dumps({"status": "ok"})
+            return wire.dumps({"status": "ok"})
         if method == "ChanPush":
             # cross-host channel leg: the WRITER pushes into a mailbox
             # hosted by this (reader) worker; a full mailbox parks the
             # push — that await IS the channel's backpressure
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             if req["name"] in self._chan_closed:
                 # torn-down reader: drop the value instead of resurrecting
                 # a mailbox nothing will ever pop again
-                return pickle.dumps({"status": "closed"})
+                return wire.dumps({"status": "closed"})
             box = self._chan_mailbox(req["name"])
             deadline = time.monotonic() + 300.0
             while len(box["q"]) >= box["cap"]:
@@ -2189,15 +2290,15 @@ class CoreWorker:
             box["q"].append(req["blob"])
             ev, box["data"] = box["data"], asyncio.Event()
             ev.set()
-            return pickle.dumps({"status": "ok"})
+            return wire.dumps({"status": "ok"})
         if method == "CancelTask":
             # reference: HandleCancelTask — cooperative raise into the
             # executing thread, or force-exit the worker process
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             if req.get("force"):
                 logger.warning("force-cancel: worker exiting")
                 self.loop.call_later(0.05, os._exit, 1)
-                return pickle.dumps({"status": "ok"})
+                return wire.dumps({"status": "ok"})
             from ray_tpu.exceptions import TaskCancelledError
 
             self._cancel_requested.add(req["task_id"])
@@ -2207,7 +2308,7 @@ class CoreWorker:
             if atask is not None:
                 if not atask.done():
                     atask.cancel()
-                return pickle.dumps({"status": "ok"})
+                return wire.dumps({"status": "ok"})
             ident = self._running_tasks.get(req["task_id"])
             if ident is not None:
                 import ctypes
@@ -2219,35 +2320,60 @@ class CoreWorker:
                     self._cancelled_pending.add(req["task_id"])
             else:
                 self._cancelled_pending.add(req["task_id"])
-            return pickle.dumps({"status": "ok"})
+            return wire.dumps({"status": "ok"})
+        if method == "ProfileStacks":
+            # py-spy-role stack sampling (dashboard/agent.py); runs in a
+            # thread so the event loop keeps serving while sampling
+            req = wire.loads(payload) or {}
+            from ray_tpu.dashboard.agent import sample_stacks
+
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, sample_stacks,
+                float(req.get("duration_s", 2.0)),
+                float(req.get("interval_ms", 10.0)))
+            return wire.dumps(out)
+        if method == "ProfileMemory":
+            req = wire.loads(payload) or {}
+            if not hasattr(self, "_mem_profiler"):
+                from ray_tpu.dashboard.agent import MemoryProfiler
+
+                self._mem_profiler = MemoryProfiler()
+            action = req.get("action", "snapshot")
+            if action == "start":
+                out = self._mem_profiler.start(int(req.get("frames", 16)))
+            elif action == "stop":
+                out = self._mem_profiler.stop()
+            else:
+                out = self._mem_profiler.snapshot(int(req.get("top", 25)))
+            return wire.dumps(out)
         if method == "Ping":
-            return pickle.dumps({"status": "ok", "pid": os.getpid()})
+            return wire.dumps({"status": "ok", "pid": os.getpid()})
         if method == "GetDeviceObject":
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             value = self.device_store.get(req["oid"])
             if value is None and req["oid"] not in self.device_store:
-                return pickle.dumps({"status": "gone"})
+                return wire.dumps({"status": "gone"})
             # large device->host copies must not stall the event loop
             self._ensure_pool(1)
             inband, buffers = await self.loop.run_in_executor(
                 self._exec_pool, serialize, value)
-            return pickle.dumps({"status": "ok",
+            return wire.dumps({"status": "ok",
                                  "blob": pack_blob(inband, buffers)})
         if method == "FreeDeviceObject":
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             freed = self.device_store.pop(req["oid"], None) is not None
-            return pickle.dumps({"freed": freed})
+            return wire.dumps({"freed": freed})
         if method == "CheckActor":
             # GCS restart recovery probe: is the given actor instantiated
             # here? (dedups in-flight creations after an init-data replay)
-            req = pickle.loads(payload)
+            req = wire.loads(payload)
             hosting = (self.actor_instance is not None
                        and self.actor_id is not None
                        and self.actor_id.binary() == req["actor_id"])
-            return pickle.dumps({"hosting": hosting})
+            return wire.dumps({"hosting": hosting})
         if method == "Exit":
             self.loop.call_later(0.1, os._exit, 0)
-            return pickle.dumps({"status": "ok"})
+            return wire.dumps({"status": "ok"})
         raise RpcError(f"core worker: unknown method {method}")
 
     async def _handle_get_owned(self, req) -> bytes:
@@ -2265,17 +2391,17 @@ class CoreWorker:
                 if not await self._recover_object(oid):
                     err = ObjectLostError(
                         f"object {oid.hex()} lost and not reconstructable")
-                    return pickle.dumps({"status": "error",
+                    return wire.dumps({"status": "error",
                                          "error": pickle.dumps(err)})
         while True:
             if oid in self.memory_store:
                 value = self.memory_store[oid]
                 if isinstance(value, TaskError):
-                    return pickle.dumps({"status": "error", "error": pickle.dumps(value)})
-                return pickle.dumps({"status": "value",
+                    return wire.dumps({"status": "error", "error": pickle.dumps(value)})
+                return wire.dumps({"status": "value",
                                      "blob": pack_blob(*serialize(value))})
             if self._in_store.get(oid):
-                return pickle.dumps({"status": "in_store"})
+                return wire.dumps({"status": "in_store"})
             fut = self._result_futures.get(oid)
             if fut is not None and not fut.done() and time.monotonic() < deadline:
                 try:
@@ -2289,9 +2415,9 @@ class CoreWorker:
                 # hit zero) or never existed — error beats an eternal poll
                 err = ObjectLostError(
                     f"object {oid.hex()} was freed by its owner")
-                return pickle.dumps({"status": "error",
+                return wire.dumps({"status": "error",
                                      "error": pickle.dumps(err)})
-            return pickle.dumps({"status": "pending"})
+            return wire.dumps({"status": "pending"})
 
     async def _handle_push_task(self, spec: TaskSpec) -> bytes:
         if spec.is_actor_creation:
@@ -2323,7 +2449,7 @@ class CoreWorker:
             self._exec_pool, self._call_user_fn, fn, args, kwargs, spec)
         self._trace_task(spec, getattr(fn, "__name__", "task"), t0, err)
         del args, kwargs  # drop our handles before computing borrows
-        return pickle.dumps(await self._pack_results(
+        return wire.dumps(await self._pack_results(
             spec, result, err, borrows=self._surviving_borrows(seen_refs)))
 
     async def _exec_normal_batch(self, specs: List[TaskSpec]) -> List[dict]:
@@ -2453,18 +2579,113 @@ class CoreWorker:
                 await self._store_blob(oid, inband, buffers, spec.attempt)
                 payload = {"task_id": tid_b, "index": index,
                            "kind": "store", "attempt": spec.attempt}
-            await owner.call("StreamTaskReturn", pickle.dumps(payload),
+            await owner.call("StreamTaskReturn", wire.dumps(payload),
                              timeout=60.0, retries=2)
             index += 1
         self._trace_task(spec, getattr(fn, "__name__", "stream"), t0, err)
         del args, kwargs, gen
         if err is not None:
-            return pickle.dumps({"status": "app_error",
+            return wire.dumps({"status": "app_error",
                                  "error": pickle.dumps(err)})
         reply = await self._pack_results(
             spec, None, None, borrows=self._surviving_borrows(seen_refs))
         reply["stream_count"] = index
-        return pickle.dumps(reply)
+        return wire.dumps(reply)
+
+    async def _exec_actor_streaming(self, spec: TaskSpec, method, args,
+                                    kwargs, seen_refs) -> bytes:
+        """Streaming actor method (num_returns="streaming"): same yield-by-
+        yield StreamTaskReturn protocol as task generators, for sync AND
+        async generator methods — async generators stream straight off the
+        actor's event loop under the concurrency semaphore (the shape LLM
+        token streaming needs). Reference: dynamic returns for actor tasks
+        in task_manager.cc + serve's streaming replica handlers."""
+        import inspect
+
+        from ray_tpu.exceptions import TaskCancelledError
+
+        owner = self._worker_client(spec.owner_address)
+        tid_b = spec.task_id.binary()
+        t0 = time.time()
+        index = 0
+        err = None
+
+        async def _ship(value, index):
+            oid = ObjectID.for_task_return(spec.task_id, index)
+            inband, buffers = serialize(value)
+            total = len(inband) + sum(b.nbytes for b in buffers)
+            if total < RAY_CONFIG.object_inline_max_bytes:
+                payload = {"task_id": tid_b, "index": index,
+                           "kind": "inline", "attempt": spec.attempt,
+                           "blob": pack_blob(inband, buffers)}
+            else:
+                await self._store_blob(oid, inband, buffers, spec.attempt)
+                payload = {"task_id": tid_b, "index": index,
+                           "kind": "store", "attempt": spec.attempt}
+            await owner.call("StreamTaskReturn", wire.dumps(payload),
+                             timeout=60.0, retries=2)
+
+        if inspect.isasyncgenfunction(method):
+            async with self._actor_sem:
+                try:
+                    agen = method(*args, **kwargs)
+                    async for value in agen:
+                        if tid_b in self._cancelled_pending:
+                            self._cancelled_pending.discard(tid_b)
+                            err = TaskCancelledError()
+                            await agen.aclose()
+                            break
+                        await _ship(value, index)
+                        index += 1
+                except TaskCancelledError as e:
+                    err = e
+                except Exception as e:
+                    err = TaskError(repr(e), traceback.format_exc())
+        else:
+            self._ensure_pool(1)
+
+            def _start():
+                try:
+                    out = method(*args, **kwargs)
+                    if not hasattr(out, "__next__"):
+                        return None, TaskError(
+                            f"streaming actor method {spec.method_name} did "
+                            f"not return a generator "
+                            f"(got {type(out).__name__})", "")
+                    return out, None
+                except Exception as e:
+                    return None, TaskError(repr(e), traceback.format_exc())
+
+            gen, err = await self.loop.run_in_executor(self._exec_pool, _start)
+            while err is None:
+                def _step():
+                    if tid_b in self._cancelled_pending:
+                        self._cancelled_pending.discard(tid_b)
+                        return None, True, TaskCancelledError()
+                    try:
+                        return next(gen), False, None
+                    except StopIteration:
+                        return None, True, None
+                    except Exception as e:
+                        return None, True, TaskError(repr(e),
+                                                     traceback.format_exc())
+
+                value, done, err = await self.loop.run_in_executor(
+                    self._exec_pool, _step)
+                if done:
+                    break
+                await _ship(value, index)
+                index += 1
+            del gen
+        self._trace_task(spec, spec.method_name, t0, err)
+        del args, kwargs
+        if err is not None:
+            return wire.dumps({"status": "app_error",
+                               "error": pickle.dumps(err)})
+        reply = await self._pack_results(
+            spec, None, None, borrows=self._surviving_borrows(seen_refs))
+        reply["stream_count"] = index
+        return wire.dumps(reply)
 
     def _trace_task(self, spec: TaskSpec, name: str, t0: float, err,
                     t1: Optional[float] = None):
@@ -2527,7 +2748,11 @@ class CoreWorker:
 
         async def _resolve(v):
             if isinstance(v, ObjectRef):
-                value = await self._get_one(v, time.monotonic() + RAY_CONFIG.object_pull_timeout_s)
+                # task-arg pulls rank below blocked gets at the raylet's
+                # pull admission (reference: pull_manager.cc classes)
+                value = await self._get_one(
+                    v, time.monotonic() + RAY_CONFIG.object_pull_timeout_s,
+                    prio=1)
                 if isinstance(value, TaskError):
                     raise value
                 return await self._maybe_pull_device(
@@ -2618,12 +2843,12 @@ class CoreWorker:
 
         err = await self.loop.run_in_executor(self._exec_pool, _create)
         if err is not None:
-            return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
+            return wire.dumps({"status": "app_error", "error": pickle.dumps(err)})
         self._actor_async = any(
             asyncio.iscoroutinefunction(getattr(self.actor_instance, n, None))
             for n in dir(self.actor_instance) if not n.startswith("__"))
         self._actor_sem = asyncio.Semaphore(max(1, opts.max_concurrency))
-        return pickle.dumps({"status": "ok", "results": []})
+        return wire.dumps({"status": "ok", "results": []})
 
     async def _wait_for_turn(self, spec: TaskSpec):
         """Per-caller seqno ordering (reference: actor_scheduling_queue.cc):
@@ -2659,7 +2884,7 @@ class CoreWorker:
     async def _exec_actor_task(self, spec: TaskSpec) -> bytes:
         if self.actor_instance is None:
             err = TaskError("ActorUnavailableError: actor instance not initialized", "")
-            return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
+            return wire.dumps({"status": "app_error", "error": pickle.dumps(err)})
         if spec.seqno > 0:
             await self._wait_for_turn(spec)
         if spec.method_name == "__rtpu_dag_loop__":
@@ -2675,14 +2900,14 @@ class CoreWorker:
                 self._dag_runner = runner  # keep alive with the actor
             except Exception as e:
                 err = TaskError(repr(e), traceback.format_exc())
-                return pickle.dumps({"status": "app_error",
+                return wire.dumps({"status": "app_error",
                                      "error": pickle.dumps(err)})
-            return pickle.dumps({"status": "ok", "results": [
+            return wire.dumps({"status": "ok", "results": [
                 ("inline", pack_blob(*serialize("started")))]})
         method = getattr(self.actor_instance, spec.method_name, None)
         if method is None:
             err = TaskError(f"AttributeError: no method {spec.method_name}", "")
-            return pickle.dumps({"status": "app_error", "error": pickle.dumps(err)})
+            return wire.dumps({"status": "app_error", "error": pickle.dumps(err)})
         # per-call options win over the decorator; "object" forces the
         # plain object-plane return (reference: ray.method override order)
         transport = (getattr(spec, "tensor_transport", "")
@@ -2690,6 +2915,9 @@ class CoreWorker:
         if transport == "object":
             transport = ""
         args, kwargs, seen_refs = await self._resolve_args(spec.args_blob)
+        if spec.num_returns == -1:
+            return await self._exec_actor_streaming(
+                spec, method, args, kwargs, seen_refs)
         t0 = time.time()
         if asyncio.iscoroutinefunction(method):
             from ray_tpu.exceptions import TaskCancelledError
@@ -2721,7 +2949,7 @@ class CoreWorker:
                 self._exec_pool, self._call_user_fn, method, args, kwargs, spec)
         self._trace_task(spec, spec.method_name, t0, err)
         del args, kwargs  # drop our handles before computing borrows
-        return pickle.dumps(await self._pack_results(
+        return wire.dumps(await self._pack_results(
             spec, result, err, transport=transport,
             borrows=self._surviving_borrows(seen_refs)))
 

@@ -24,6 +24,9 @@ import asyncio
 import logging
 import os
 import pickle
+
+from ray_tpu._private import wire
+from ray_tpu.exceptions import RuntimeEnvSetupError
 import signal
 import subprocess
 import sys
@@ -119,8 +122,11 @@ class Raylet:
             max(1, RAY_CONFIG.worker_startup_concurrency))
         # bounded concurrent inbound pulls (reference: pull_manager.cc's
         # prioritized admission; FIFO here — all pulls are one class)
-        self._pull_sem = asyncio.Semaphore(
-            max(1, RAY_CONFIG.object_pull_concurrency))
+        from ray_tpu._private.pull_manager import PullQueue
+
+        self._pull_queue = PullQueue(
+            max(1, RAY_CONFIG.object_pull_concurrency),
+            stale_ttl_s=RAY_CONFIG.object_pull_interest_ttl_s)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -140,7 +146,7 @@ class Raylet:
             labels=dict(self.labels),
             is_head=self.is_head,
         )
-        await self.gcs.call("RegisterNode", pickle.dumps({"info": info}))
+        await self.gcs.call("RegisterNode", wire.dumps({"info": info}))
         await self._subscribe_view()
         self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._background.append(asyncio.ensure_future(self._monitor_workers_loop()))
@@ -183,9 +189,9 @@ class Raylet:
         a node that died in that window never heartbeats again, so only a
         fresh snapshot can correct the view."""
         client = client or self.gcs
-        await client.call("Subscribe", pickle.dumps(
+        await client.call("Subscribe", wire.dumps(
             {"channels": ["resource_view"]}))
-        reply = pickle.loads(await client.call("GetAllNodes", b""))
+        reply = wire.loads(await client.call("GetAllNodes", b""))
         for n in reply["nodes"]:
             self.cluster_view[n["node_id"]] = {
                 "address": n["address"],
@@ -198,7 +204,7 @@ class Raylet:
     def _on_gcs_push(self, channel: str, payload: bytes):
         if channel != "resource_view":
             return
-        msg = pickle.loads(payload)
+        msg = wire.loads(payload)
         self.cluster_view[msg["node_id"]] = {
             "address": msg["address"], "available": msg["available"],
             "total": msg["total"], "labels": msg["labels"],
@@ -212,7 +218,9 @@ class Raylet:
             logger.warning("resource_view re-subscribe failed", exc_info=True)
 
     def _pick_spill_node(self, resources, selector,
-                         require_available: bool = True) -> Optional[str]:
+                         require_available: bool = True,
+                         locality: Optional[Dict[str, int]] = None
+                         ) -> Optional[str]:
         """Choose a peer raylet for spillback from the synced view (hybrid
         policy: pack onto the most-utilized feasible peer below the spread
         threshold, else the least utilized; reference:
@@ -236,6 +244,15 @@ class Raylet:
         candidates.sort()
         threshold = RAY_CONFIG.scheduler_spread_threshold
         packed = [c for c in candidates if c[0] < threshold]
+        if locality:
+            # among below-threshold peers, prefer the one already holding
+            # the most argument bytes (reference: locality-aware lease
+            # policy, task_submission/lease_policy.cc): the pull it saves
+            # usually dwarfs a small utilization difference
+            pool = packed or candidates
+            best = max(pool, key=lambda c: (locality.get(c[1], 0), c[0]))
+            if locality.get(best[1], 0) > 0:
+                return best[2]
         return (packed[-1] if packed else candidates[0])[2]
 
     async def _memory_monitor_loop(self):
@@ -283,7 +300,7 @@ class Raylet:
         period = RAY_CONFIG.health_check_period_ms / 1000.0
         while True:
             try:
-                reply = pickle.loads(await self.gcs.call("Heartbeat", pickle.dumps({
+                reply = wire.loads(await self.gcs.call("Heartbeat", wire.dumps({
                     "node_id": self.node_id,
                     "available": dict(self.available),
                     # lease count keeps zero-resource actors visible to the
@@ -302,7 +319,7 @@ class Raylet:
                         object_store_address=self.server.address,
                         total_resources=dict(self.total_resources),
                         labels=dict(self.labels), is_head=self.is_head)
-                    await self.gcs.call("RegisterNode", pickle.dumps({"info": info}))
+                    await self.gcs.call("RegisterNode", wire.dumps({"info": info}))
             except (RpcError, asyncio.TimeoutError, OSError):
                 pass
             await asyncio.sleep(period)
@@ -312,15 +329,22 @@ class Raylet:
     # ------------------------------------------------------------------
 
     def _spawn_worker(self, renv: Optional[dict] = None,
-                      renv_hash: str = "") -> WorkerProc:
+                      renv_hash: str = "",
+                      python_exe: Optional[str] = None) -> WorkerProc:
         cmd = [
-            sys.executable, "-m", "ray_tpu._private.worker_main",
+            python_exe or sys.executable, "-m", "ray_tpu._private.worker_main",
             "--raylet-address", self.server.address,
             "--gcs-address", self.gcs_address,
             "--node-id", self.node_id.hex(),
             "--log-dir", self.log_dir,
         ]
         env = self._spawn_env
+        if python_exe:
+            # pip/uv env: the worker runs on the venv interpreter
+            venv_root = os.path.dirname(os.path.dirname(python_exe))
+            env = dict(env, VIRTUAL_ENV=venv_root,
+                       PATH=os.path.join(venv_root, "bin") + os.pathsep
+                       + env.get("PATH", os.environ.get("PATH", "")))
         if renv:
             import base64 as _b64
             import json as _json
@@ -364,7 +388,17 @@ class Raylet:
                         self.idle_workers.pop(i)
                         w.job_hex = w.job_hex or job_hex
                         return w
-                w = self._spawn_worker(renv, renv_hash)
+                python_exe = None
+                if renv and "pip" in renv:
+                    # venv build is blocking (pip install): off the loop.
+                    # Raises RuntimeEnvSetupError to the lease path, which
+                    # surfaces it to the owner as the task's error
+                    # (reference: runtime-env agent failure handling)
+                    from ray_tpu._private.runtime_env import ensure_env_python
+
+                    python_exe = await asyncio.get_event_loop()\
+                        .run_in_executor(None, ensure_env_python, renv)
+                w = self._spawn_worker(renv, renv_hash, python_exe)
                 await asyncio.wait_for(w.registered,
                                        RAY_CONFIG.worker_start_timeout_s)
                 w.job_hex = job_hex
@@ -404,7 +438,7 @@ class Raylet:
                 continue
             lines = data.decode(errors="replace").splitlines()
             try:
-                await self.gcs.call("Publish", pickle.dumps({
+                await self.gcs.call("Publish", wire.dumps({
                     "channel": "logs",
                     "message": {"node": node, "lines": lines[:200]},
                 }), timeout=5.0, retries=0)
@@ -459,7 +493,7 @@ class Raylet:
                     logger.warning("worker %s (pid %d) exited: %s",
                                    w.address, pid, reason)
                     try:
-                        await self.gcs.call("WorkerDied", pickle.dumps({
+                        await self.gcs.call("WorkerDied", wire.dumps({
                             "worker_address": w.address,
                             "node_id": self.node_id.hex(),
                             "reason": reason,
@@ -499,6 +533,7 @@ class Raylet:
         bundle_index = req.get("bundle_index", -1)
         selector = req.get("label_selector") or {}
         allow_spill = bool(req.get("allow_spillback"))
+        locality = req.get("locality") or {}
         renv = req.get("runtime_env")
         renv_hash = env_hash(renv)
         job_hex = req["job_id"].hex() if req.get("job_id") is not None else None
@@ -513,7 +548,8 @@ class Raylet:
         if not local_ok:
             if allow_spill:
                 alt = self._pick_spill_node(resources, selector,
-                                            require_available=False)
+                                            require_available=False,
+                                            locality=locality)
                 if alt:
                     return {"status": "spillback", "retry_at": alt}
             if pg is None and label_match(self.labels, selector):
@@ -530,6 +566,13 @@ class Raylet:
                     resources_sub(pool, resources)
                     try:
                         w = await self._pop_worker(job_hex, renv, renv_hash)
+                    except RuntimeEnvSetupError as e:
+                        # deterministic env-build failure: a structured
+                        # terminal status, not a retriable RPC error —
+                        # the owner fails the task with the pip output
+                        resources_add(pool, resources)
+                        return {"status": "runtime_env_failed",
+                                "error": str(e)}
                     except (asyncio.TimeoutError, Exception):
                         resources_add(pool, resources)
                         raise
@@ -537,7 +580,7 @@ class Raylet:
                     w.leases.add(lease_id)
                     w.last_assigned = time.monotonic()
                     # remember which pool to credit on release
-                    self.leases[lease_id] = (w, resources, pickle.dumps((pg, bundle_index)))
+                    self.leases[lease_id] = (w, resources, wire.dumps((pg, bundle_index)))
                     return {
                         "status": "granted",
                         "lease_id": lease_id,
@@ -573,7 +616,7 @@ class Raylet:
         if entry is None:
             return
         w, resources, pool_key = entry
-        pg, bundle_index = pickle.loads(pool_key)
+        pg, bundle_index = wire.loads(pool_key)
         pool = self._lease_pool(pg, bundle_index)
         if pool is not None:
             resources_add(pool, resources)
@@ -632,7 +675,18 @@ class Raylet:
         return {"status": "ok"}
 
     async def _rpc_GetNodeStats(self, req, conn):
+        agent_stats = {}
+        if req.get("agent"):
+            # per-node agent sample (reference: dashboard agent reporter):
+            # psutil walk of every worker, off the loop
+            if not hasattr(self, "_agent"):
+                from ray_tpu.dashboard.agent import NodeAgent
+
+                self._agent = NodeAgent()
+            agent_stats = await asyncio.get_event_loop().run_in_executor(
+                None, self._agent.collect, list(self.workers.keys()))
         return {
+            "agent": agent_stats,
             "node_id": self.node_id.hex(),
             "total_resources": dict(self.total_resources),
             "available": dict(self.available),
@@ -644,6 +698,21 @@ class Raylet:
             "cluster_view_size": sum(
                 1 for v in self.cluster_view.values() if v["alive"]),
         }
+
+    async def _rpc_ProfileWorker(self, req, conn):
+        """Route a profiling request to one of this node's workers
+        (reference: dashboard ReporterService.GetTraceback / py-spy RPC)."""
+        pid = req.get("pid")
+        w = self.workers.get(pid)
+        if w is None or not w.address:
+            return {"status": "not_found",
+                    "pids": sorted(self.workers.keys())}
+        method = "ProfileMemory" if req.get("kind") == "memory" \
+            else "ProfileStacks"
+        out = wire.loads(await w.client.call(
+            method, wire.dumps(req.get("args") or {}),
+            timeout=float(req.get("timeout", 60.0))))
+        return {"status": "ok", "pid": pid, "profile": out}
 
     # ------------------------------------------------------------------
     # placement group bundles (reference: placement_group_resource_manager.cc)
@@ -723,8 +792,9 @@ class Raylet:
 
     async def _announce(self, oids: List[bytes], attempt: int = 0):
         try:
-            await self.gcs.call("ObjectLocAdd", pickle.dumps(
+            await self.gcs.call("ObjectLocAdd", wire.dumps(
                 {"oids": oids, "node_id": self.node_id,
+                 "sizes": {o: self.store.object_size(o) for o in oids},
                  "attempt": attempt}), retries=2)
         except (RpcError, asyncio.TimeoutError, OSError):
             logger.warning("failed to announce %d object locations", len(oids))
@@ -732,9 +802,17 @@ class Raylet:
     async def _rpc_StoreGet(self, req, conn):
         oid = req["oid"]
         timeout = req.get("timeout", RAY_CONFIG.object_pull_timeout_s)
-        if not self.store.contains(oid) and req.get("pull", True):
-            self._ensure_pull(oid)
-        ok = await self.store.wait_local(oid, timeout)
+        pulling = not self.store.contains(oid) and req.get("pull", True)
+        if pulling:
+            # priority class rides the request: 0 = blocked get, 1 = task
+            # arg, 2 = background (reference: pull_manager.cc priorities)
+            self._ensure_pull(oid, prio=int(req.get("prio", 1)))
+            self._pull_queue.add_waiter(oid)
+        try:
+            ok = await self.store.wait_local(oid, timeout)
+        finally:
+            if pulling:
+                self._pull_queue.remove_waiter(oid)
         if not ok:
             return {"status": "timeout"}
         return self.store.access(oid)
@@ -754,7 +832,7 @@ class Raylet:
     async def _rpc_StoreDelete(self, req, conn):
         self.store.delete(req["oids"])
         try:
-            await self.gcs.call("ObjectLocRemove", pickle.dumps(
+            await self.gcs.call("ObjectLocRemove", wire.dumps(
                 {"oids": req["oids"], "node_id": self.node_id}), retries=1)
         except (RpcError, asyncio.TimeoutError, OSError):
             pass
@@ -763,12 +841,13 @@ class Raylet:
     async def _rpc_StoreStats(self, req, conn):
         return self.store.stats()
 
-    def _ensure_pull(self, oid: bytes):
+    def _ensure_pull(self, oid: bytes, prio: int = 1):
+        self._pull_queue.request(oid, prio)  # registers or upgrades
         if oid in self._pulls and not self._pulls[oid].done():
             return
-        self._pulls[oid] = asyncio.ensure_future(self._pull(oid))
+        self._pulls[oid] = asyncio.ensure_future(self._pull(oid, prio))
 
-    async def _pull(self, oid: bytes):
+    async def _pull(self, oid: bytes, prio: int = 1):
         """Chunked transfer from a remote node's store (reference:
         object_manager/pull_manager.cc + push_manager.cc). Bounded
         concurrency (FIFO through a semaphore) keeps a burst of pulls from
@@ -777,9 +856,9 @@ class Raylet:
         announces a new location, an N-node broadcast forms an organic
         fan-out tree off the origin instead of an N-deep queue on it
         (reference: the 1 GiB / 50-node broadcast envelope)."""
-        await self._pull_inner(oid)
+        await self._pull_inner(oid, prio)
 
-    async def _pull_inner(self, oid: bytes):
+    async def _pull_inner(self, oid: bytes, prio: int = 1):
         import random as _random
 
         deadline = time.monotonic() + RAY_CONFIG.object_pull_timeout_s
@@ -788,8 +867,8 @@ class Raylet:
             if self.store.contains(oid):
                 return
             try:
-                reply = pickle.loads(await self.gcs.call(
-                    "ObjectLocGet", pickle.dumps({"oid": oid}), retries=2))
+                reply = wire.loads(await self.gcs.call(
+                    "ObjectLocGet", wire.dumps({"oid": oid}), retries=2))
             except (RpcError, asyncio.TimeoutError, OSError):
                 await asyncio.sleep(0.2)
                 continue
@@ -801,26 +880,36 @@ class Raylet:
             src = RetryingRpcClient(locations[0]["address"])
             attempt = None  # set once meta arrives; guards the except path
             try:
-                # the concurrency bound covers only the actual TRANSFER:
+                # the admission bound covers only the actual TRANSFER:
                 # a slot must not be parked on location polling for an
-                # object nobody has announced yet
-                async with self._pull_sem:
+                # object nobody has announced yet. Admission is by
+                # (priority class, FIFO); False means the queued pull went
+                # obsolete (every waiter left) and was cancelled
+                if not await self._pull_queue.admit(oid):
+                    logger.info("pull %s cancelled (no waiters)",
+                                oid.hex()[:12])
+                    return
+                try:
                     if self.store.contains(oid):
                         return
                     await self._pull_transfer(oid, src, chunk)
+                finally:
+                    self._pull_queue.release(oid)
                 return
             except _PullRetry:
+                self._pull_queue.request(oid, prio)
                 await asyncio.sleep(0.1)
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 logger.warning("pull %s from %s failed: %s", oid.hex()[:12],
                                locations[0]["address"], e)
+                self._pull_queue.request(oid, prio)
                 await asyncio.sleep(0.2)
             finally:
                 await src.close()
         logger.warning("pull %s timed out", oid.hex()[:12])
 
     async def _pull_transfer(self, oid: bytes, src, chunk: int):
-        meta = pickle.loads(await src.call("StoreMeta", pickle.dumps({"oid": oid})))
+        meta = wire.loads(await src.call("StoreMeta", wire.dumps({"oid": oid})))
         size = meta.get("size")
         if size is None:
             raise _PullRetry()
@@ -835,7 +924,7 @@ class Raylet:
             offset = 0
             while offset < size:
                 n = min(chunk, size - offset)
-                r = pickle.loads(await src.call("StoreFetchChunk", pickle.dumps(
+                r = wire.loads(await src.call("StoreFetchChunk", wire.dumps(
                     {"oid": oid, "offset": offset, "length": n,
                      "attempt": attempt})))
                 data = r.get("data")
@@ -864,9 +953,9 @@ class Raylet:
         fn = getattr(self, f"_rpc_{method}", None)
         if fn is None:
             raise RpcError(f"raylet: unknown method {method}")
-        req = pickle.loads(payload) if payload else {}
+        req = wire.loads(payload) if payload else {}
         resp = await fn(req, conn)
-        return pickle.dumps(resp)
+        return wire.dumps(resp)
 
 
 def main():

@@ -71,7 +71,7 @@ def test_runtime_env_actor(cluster):
 
 def test_runtime_env_unsupported_field(cluster):
     with pytest.raises(ValueError, match="not supported"):
-        @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+        @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["requests"]}})
         def f():
             return 1
 
