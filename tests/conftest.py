@@ -7,6 +7,12 @@ import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["RAY_TPU_JAX_PLATFORMS"] = "cpu"  # honored by ray_tpu.utils.import_jax
+# The CPU test tier never touches the TPU plugin: dropping the pool address
+# keeps the site hook from eagerly importing jax + registering PJRT in EVERY
+# spawned process (raylets, workers) — ~3s and ~140MB per process, which on a
+# 1-CPU CI box dominates suite wall-clock and memory. Workers that need jax
+# import it lazily on CPU.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"

@@ -1,0 +1,37 @@
+"""Scaled-down control-plane stress envelope (reference: release/benchmarks/
+distributed/many_nodes_tests — the full-size run lives in tools/stress.py and
+its committed STRESS_r{N}.json).
+
+Asserts the envelope COMPLETES — every task result accounted for, every actor
+reachable, every PG reaches ready and releases its bundles — at a scale CI can
+afford; throughput numbers come from the full run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_stress_envelope_scaled(tmp_path):
+    # already subprocess-isolated: the whole envelope runs in its own
+    # interpreter via tools/stress.py
+    out = tmp_path / "stress.json"
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "stress.py"),
+         "--nodes", "6", "--tasks", "1500", "--actors", "40", "--pgs", "12",
+         "--broadcast-mb", "16", "--out", str(out)],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=780)
+    assert proc.returncode == 0, (
+        f"stress run failed\n--- stdout ---\n{proc.stdout[-4000:]}"
+        f"\n--- stderr ---\n{proc.stderr[-3000:]}")
+    result = json.loads(out.read_text())
+    assert result["tasks"] == 1500
+    assert result["actors"] == 40
+    assert result["pgs"] == 12
+    assert result["broadcast_nodes"] == 6
+    assert result["tasks_per_s"] > 20
