@@ -184,7 +184,52 @@ class _Replica:
         finally:
             self._num_ongoing -= 1
 
+    async def handle_request_streaming(self, method_name: str,
+                                       args_blob: bytes):
+        """Async-generator entry: yields response chunks as the user
+        target produces them. Invoked with num_returns="streaming" so each
+        yield streams to the caller immediately (reference:
+        serve/_private/replica.py UserCallableWrapper.call_user_generator +
+        proxy streaming responses)."""
+        import inspect
+
+        import cloudpickle as _cp
+
+        args, kwargs = _cp.loads(args_blob)
+        kwargs.pop("_serve_multiplexed_model_id", "")
+        if method_name == "__call__":
+            fn = self._callable
+        else:
+            fn = getattr(self._callable, method_name)
+        self._num_ongoing += 1
+        try:
+            if inspect.isasyncgenfunction(fn):
+                async for chunk in fn(*args, **kwargs):
+                    yield chunk
+                return
+            out = fn(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = await out
+            if hasattr(out, "__aiter__"):
+                async for chunk in out:
+                    yield chunk
+            elif hasattr(out, "__next__") or (
+                    hasattr(out, "__iter__")
+                    and not isinstance(out, (str, bytes, dict))):
+                for chunk in out:
+                    yield chunk
+            else:
+                yield out
+        finally:
+            self._num_ongoing -= 1
+
     def num_ongoing(self) -> int:
+        return self._num_ongoing
+
+    def drain(self) -> int:
+        """Rolling update support: called on a replica that has been
+        removed from the topology; returns outstanding request count so
+        the controller can kill it only when it reaches zero."""
         return self._num_ongoing
 
     def health(self) -> bool:
@@ -235,18 +280,22 @@ class _ServeController:
         cfg = _cp.loads(cfg_blob)
         with self._mutate:
             old = self.apps.get(name)
-            version = 0
             if old:
-                # versions survive redeploys so long-pollers can't collide
-                # with the new app's counter and miss the change
-                version = old["version"] + 1
-                for r in old["replicas"]:
-                    try:
-                        ray_tpu.kill(r)
-                    except Exception:
-                        pass
+                # versioned ROLLING update (reference: serve/_private/
+                # deployment_state.py _check_and_update_replicas): keep the
+                # old code version serving; the reconcile loop replaces
+                # replicas one at a time, draining each before killing it,
+                # so no request is dropped during an upgrade
+                old.update({"blob": target_blob, "init": init_blob,
+                            "cfg": cfg, "target": cfg.num_replicas})
+                old["code_version"] += 1
+                old["version"] += 1
+                self._reconcile(name)
+                return True
             self.apps[name] = {"blob": target_blob, "init": init_blob,
-                               "cfg": cfg, "replicas": [], "version": version,
+                               "cfg": cfg, "replicas": [], "version": 0,
+                               "code_version": 0, "replica_versions": {},
+                               "rollout": None,
                                "target": cfg.num_replicas,
                                "scale_up_since": None, "scale_down_since": None}
             self._reconcile(name)
@@ -292,7 +341,10 @@ class _ServeController:
                     except Exception:
                         pass
         changed = len(alive) != len(app["replicas"])
-        while len(alive) < want:
+        rv = app.setdefault("replica_versions", {})
+        code_version = app.setdefault("code_version", 0)
+
+        def _start_replica():
             opts = dict(cfg.ray_actor_options)
             replica = _api._Replica.options(
                 num_cpus=opts.get("num_cpus", 1.0),
@@ -301,17 +353,97 @@ class _ServeController:
                 max_concurrency=cfg.max_ongoing_requests,
                 max_restarts=-1,
             ).remote(app["blob"], app["init"])
-            alive.append(replica)
+            rv[replica] = code_version
+            return replica
+
+        while len(alive) < want:
+            alive.append(_start_replica())
             changed = True
         for extra in alive[want:]:
             changed = True
+            rv.pop(extra, None)
             try:
                 ray_tpu.kill(extra)
             except Exception:
                 pass
         app["replicas"] = alive[:want]
+        keep = {id(app.get("surge_replica")),
+                id((app.get("rollout") or {}).get("draining"))}
+        for r in list(rv):
+            if r not in app["replicas"] and id(r) not in keep:
+                rv.pop(r, None)
+        if self._advance_rollout(name, app):
+            changed = True
         if changed:
             self._bump(name)
+
+    def _advance_rollout(self, name: str, app: dict) -> bool:
+        """One rolling-update step per control-loop tick (reference:
+        deployment_state.py's max-surge-1 rollout): start ONE new-version
+        replica; once it answers health, pull ONE old-version replica out
+        of the topology; kill it only when drained (or after the graceful
+        window). Returns True if the topology changed."""
+        import time as _t
+
+        rv = app["replica_versions"]
+        code_version = app["code_version"]
+        ro = app.get("rollout")
+        changed = False
+        if ro is not None:
+            # a drain is in flight. The victim left the topology, but handle
+            # caches refresh on a ~5s TTL — keep it ALIVE (still serving)
+            # for a propagation grace so stale routers hit a live replica,
+            # then kill once idle (hard-capped by the graceful window)
+            draining = ro["draining"]
+            now = _t.monotonic()
+            done = now >= ro["deadline"]
+            if not done and now - ro["removed_at"] >= 6.0:
+                try:
+                    done = ray_tpu.get(draining.drain.remote(),
+                                       timeout=5.0) == 0
+                except Exception:
+                    done = True  # already dead
+            if done:
+                rv.pop(draining, None)
+                try:
+                    ray_tpu.kill(draining)
+                except Exception:
+                    pass
+                app["rollout"] = None
+            return False
+        stale = [r for r in app["replicas"] if rv.get(r, 0) != code_version]
+        if not stale:
+            return False
+        # surge one new-version replica, wait for it to answer health
+        surge = app.get("surge_replica")
+        if surge is None:
+            opts = dict(app["cfg"].ray_actor_options)
+            from ray_tpu.serve import api as _api
+
+            surge = _api._Replica.options(
+                num_cpus=opts.get("num_cpus", 1.0),
+                num_tpus=opts.get("num_tpus", 0.0),
+                resources=opts.get("resources", {}),
+                max_concurrency=app["cfg"].max_ongoing_requests,
+                max_restarts=-1,
+            ).remote(app["blob"], app["init"])
+            app["surge_replica"] = surge
+            rv[surge] = code_version
+            return False
+        try:
+            ray_tpu.get(surge.health.remote(), timeout=5.0)
+        except Exception:
+            return False  # not ready yet; try next tick
+        # swap: new replica enters the topology, oldest stale leaves it
+        victim = stale[0]
+        replicas = [r for r in app["replicas"] if r is not victim] + [surge]
+        app["replicas"] = replicas
+        app["surge_replica"] = None
+        app["rollout"] = {
+            "draining": victim, "removed_at": _t.monotonic(),
+            "deadline": _t.monotonic()
+            + getattr(app["cfg"], "graceful_shutdown_timeout_s", 30.0)}
+        return True
 
     def _autoscale(self, name: str):
         """Average ongoing requests per replica vs. target, with up/down
@@ -461,22 +593,25 @@ class DeploymentHandle:
     fed by the controller's versioned topology (long-pollable)."""
 
     def __init__(self, deployment_name: str, method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "", stream: bool = False):
         self._name = deployment_name
         self._method = method_name
         self._model_id = multiplexed_model_id
+        self._stream = stream
         self._replicas: List[Any] = []
         self._version = -1
         self._pending: Dict[Any, int] = {}
         self._last_refresh = 0.0
 
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         h = DeploymentHandle(
             self._name,
             method_name if method_name is not None else self._method,
             multiplexed_model_id if multiplexed_model_id is not None
-            else self._model_id)
+            else self._model_id,
+            stream if stream is not None else self._stream)
         h._replicas = self._replicas
         h._version = self._version
         h._pending = self._pending
@@ -557,10 +692,16 @@ class DeploymentHandle:
         # pending counters decay by zeroing at each periodic refresh
         self._pending[replica] = self._pending.get(replica, 0) + 1
         blob = cloudpickle.dumps((args, kwargs))
+        if self._stream:
+            # ObjectRefGenerator of chunk refs, produced as the replica
+            # yields (reference: handle.options(stream=True))
+            return replica.handle_request_streaming.options(
+                num_returns="streaming").remote(self._method, blob)
         return replica.handle_request.remote(self._method, blob)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._name, self._method, self._model_id))
+        return (DeploymentHandle,
+                (self._name, self._method, self._model_id, self._stream))
 
 
 def get_app_handle(name: str) -> DeploymentHandle:
@@ -696,15 +837,79 @@ class _HttpProxy:
             h = DeploymentHandle(name)
             return ray_tpu.get(h.remote(body), timeout=120)
 
+        def _encode_chunk(chunk) -> bytes:
+            if isinstance(chunk, bytes):
+                return chunk
+            if isinstance(chunk, str):
+                return chunk.encode()
+            return (json.dumps(chunk) + "\n").encode()
+
         async def handle(request):
             name = request.match_info["name"]
             try:
                 body = await request.json() if request.can_read_body else {}
             except Exception:
                 body = {}
+            stream = request.query.get("stream") in ("1", "true") or \
+                "text/event-stream" in request.headers.get("Accept", "")
+            loop = asyncio.get_event_loop()
+            if stream:
+                # chunked response: each replica yield is flushed to the
+                # client as it arrives (reference: proxy.py streaming
+                # responses for generator deployments). A thread-safe
+                # queue + stop flag, with every block bounded, so a client
+                # disconnect can never strand the pump thread
+                import queue as _qmod
+                import threading as _th
+
+                q: _qmod.Queue = _qmod.Queue(maxsize=8)
+                stop = _th.Event()
+                _END = object()
+
+                def _put(item) -> bool:
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.5)
+                            return True
+                        except _qmod.Full:
+                            continue
+                    return False
+
+                def _pump():
+                    try:
+                        h = DeploymentHandle(name, stream=True)
+                        for ref in h.remote(body):
+                            if not _put(ray_tpu.get(ref, timeout=120)):
+                                return  # client left; drop the stream
+                        _put(_END)
+                    except Exception as e:
+                        _put(RuntimeError(str(e)))
+
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "application/octet-stream",
+                             "Transfer-Encoding": "chunked"})
+                await resp.prepare(request)
+                loop.run_in_executor(None, _pump)
+                try:
+                    while True:
+                        try:
+                            item = await loop.run_in_executor(
+                                None, functools.partial(q.get, timeout=0.5))
+                        except _qmod.Empty:
+                            continue
+                        if item is _END:
+                            break
+                        if isinstance(item, RuntimeError):
+                            await resp.write(_encode_chunk(
+                                {"error": str(item)}))
+                            break
+                        await resp.write(_encode_chunk(item))
+                    await resp.write_eof()
+                finally:
+                    stop.set()
+                return resp
             try:
                 # route off-loop: handle calls block on the core worker
-                loop = asyncio.get_event_loop()
                 result = await loop.run_in_executor(
                     None, functools.partial(_route, name, body))
                 return web.json_response({"result": result})
