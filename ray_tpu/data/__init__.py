@@ -6,6 +6,14 @@ per-host TPU input pipelines via iter_batches / Train dataset sharding.
 
 from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.context import DataContext
+from ray_tpu.data.datasources import (
+    from_huggingface,
+    read_sql,
+    read_tfrecords,
+    read_webdataset,
+    write_tfrecords,
+    write_webdataset,
+)
 from ray_tpu.data.dataset import (
     Dataset,
     GroupedData,
@@ -36,5 +44,11 @@ __all__ = [
     "read_csv",
     "read_json",
     "read_text",
+    "read_tfrecords",
+    "read_webdataset",
+    "read_sql",
+    "from_huggingface",
+    "write_tfrecords",
+    "write_webdataset",
     "read_binary_files",
 ]

@@ -86,6 +86,21 @@ class StreamingExecutor:
     def stats_summary(self) -> str:
         return "\n".join(op.stats.summary() for op in self.ops)
 
+    def stats_data(self) -> list:
+        """Structured per-op runtime metrics (reference:
+        data/_internal/stats.py + op_runtime_metrics.py)."""
+        import time as _t
+
+        out = []
+        for op in self.ops:
+            st = op.stats
+            wall = (st.end_ts or _t.time()) - (st.start_ts or _t.time())
+            out.append({"op": st.name, "tasks": st.tasks,
+                        "rows_out": st.rows_out, "bytes_out": st.bytes_out,
+                        "task_wall_s": round(st.task_wall_s, 4),
+                        "wall_s": round(wall, 4)})
+        return out
+
     # -- loop ----------------------------------------------------------
 
     def _global_cap(self) -> int:

@@ -282,6 +282,8 @@ class Dataset:
         try:
             yield from executor.iter_output()
             self._last_stats = executor.stats_summary()
+            self._last_stats_data = executor.stats_data()
+            self._publish_stats()
         finally:
             executor.stop()
 
@@ -292,6 +294,40 @@ class Dataset:
 
     def stats(self) -> str:
         return self._last_stats
+
+    def stats_data(self) -> list:
+        """Structured per-op metrics from the last execution (reference:
+        data/_internal/stats.py DatasetStats)."""
+        return getattr(self, "_last_stats_data", [])
+
+    def _publish_stats(self):
+        """Surface the last run's stats through the state API / dashboard
+        (GCS KV ns="data_stats"); best-effort, skipped in local mode."""
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            core = worker_mod.global_worker()
+            if getattr(core, "mode", "") == "local" \
+                    or not hasattr(core, "_gcs_call"):
+                return
+            import time as _t
+
+            from ray_tpu._private import wire
+
+            core._run(core._gcs_call("KVPut", {
+                "ns": "data_stats", "key": self._stats_key(),
+                "value": wire.dumps({"ts": _t.time(),
+                                     "ops": self._last_stats_data})}),
+                5.0)
+        except Exception:
+            pass
+
+    def _stats_key(self) -> str:
+        if not hasattr(self, "_stats_uuid"):
+            import uuid as _uuid
+
+            self._stats_uuid = _uuid.uuid4().hex[:12]
+        return self._stats_uuid
 
     # ------------------------------------------------------------------
     # consumption
@@ -323,6 +359,77 @@ class Dataset:
                 yield BlockAccessor(BlockAccessor.build_from_rows(chunk)).to_batch()
         if carry and not drop_last:
             yield BlockAccessor(BlockAccessor.build_from_rows(carry)).to_batch()
+
+    def iter_device_batches(self, batch_size: int = 256,
+                            drop_last: bool = False,
+                            device_prefetch: int = 2,
+                            sharding=None) -> Iterator[Any]:
+        """Device-fed iteration (reference: data/iterator.py
+        iter_torch_batches:106,269 — the Train ingestion path): a producer
+        thread pulls host batches and starts their host->device transfer
+        (``jax.device_put``, async dispatch) ``device_prefetch`` batches
+        ahead, so the consumer's step compute overlaps the next batch's
+        transfer instead of waiting on it. Yields pytrees of jax Arrays
+        (placed per ``sharding`` when given, e.g. a data-parallel
+        NamedSharding for a Train mesh)."""
+        import queue as _q
+        import threading as _th
+
+        from ray_tpu.utils import import_jax
+
+        jax = import_jax()
+        depth = max(1, int(device_prefetch))
+        q: _q.Queue = _q.Queue(maxsize=depth)
+        stop = _th.Event()
+        _END, _ERR = object(), object()
+
+        def _put_device(batch):
+            if sharding is not None:
+                return jax.device_put(batch, sharding)
+            return jax.device_put(batch)
+
+        def _enqueue(item) -> bool:
+            # every block is bounded so an early-exiting consumer (break
+            # mid-epoch) releases this thread instead of stranding it on a
+            # full queue
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.25)
+                    return True
+                except _q.Full:
+                    continue
+            return False
+
+        def _producer():
+            try:
+                for batch in self.iter_batches(batch_size=batch_size,
+                                               drop_last=drop_last):
+                    if not _enqueue(_put_device(batch)):
+                        return
+                _enqueue(_END)
+            except BaseException as e:  # surface in the consumer
+                _enqueue((_ERR, e))
+
+        t = _th.Thread(target=_producer, daemon=True,
+                       name="ray_tpu-device-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is _ERR:
+                    raise item[1]
+                yield item
+        finally:
+            stop.set()
+            while not q.empty():  # unblock a producer parked on put
+                try:
+                    q.get_nowait()
+                except _q.Empty:
+                    break
+            t.join(timeout=2.0)
 
     def take(self, n: int = 20) -> List[Any]:
         out: List[Any] = []

@@ -1,0 +1,84 @@
+"""Device-fed data iteration + structured Dataset stats (reference:
+python/ray/data/iterator.py:106,269 iter_torch_batches device prefetch;
+data/_internal/stats.py per-op metrics)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 4.0})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_iter_device_batches_yields_device_arrays(cluster):
+    ds = rdata.range(400).map_batches(
+        lambda b: {"x": np.asarray(b["id"], dtype=np.float32) * 2.0})
+    total = 0
+    import jax
+
+    for batch in ds.iter_device_batches(batch_size=64, device_prefetch=2):
+        assert isinstance(batch["x"], jax.Array)
+        total += int(batch["x"].shape[0])
+        assert float(batch["x"][0]) % 2.0 == 0.0
+    assert total == 400
+
+
+def test_device_prefetch_overlaps_consumer_compute(cluster):
+    """With a deliberately slow consumer, prefetched iteration overlaps the
+    producer's block fetch + H2D with the consumer's step; unprefetched
+    iteration serializes them."""
+
+    def slow_map(b):
+        time.sleep(0.03)
+        return {"x": np.asarray(b["id"], dtype=np.float32)}
+
+    def run(depth):
+        ds = rdata.range(1200, parallelism=12).map_batches(slow_map)
+        t0 = time.perf_counter()
+        n = 0
+        for batch in ds.iter_device_batches(batch_size=100,
+                                            device_prefetch=depth):
+            time.sleep(0.03)  # consumer "compute"
+            n += batch["x"].shape[0]
+        assert n == 1200
+        return time.perf_counter() - t0
+
+    serial = run(1)  # depth-1 still pipelines one ahead; warms compiles
+    fast = run(3)
+    # the producer thread + deeper window must not be slower; usually it
+    # overlaps a real fraction of the consumer sleep
+    assert fast < serial * 1.25, (fast, serial)
+
+
+def test_stats_data_per_op(cluster):
+    ds = rdata.range(300).map_batches(
+        lambda b: {"x": np.asarray(b["id"]) + 1})
+    list(ds.iter_batches(batch_size=50))
+    stats = ds.stats_data()
+    assert stats, "expected per-op stats"
+    assert any(s["rows_out"] >= 300 for s in stats), stats
+    for s in stats:
+        assert {"op", "tasks", "rows_out", "bytes_out",
+                "task_wall_s", "wall_s"} <= set(s)
+    # string form still renders
+    assert "rows" in ds.stats()
+
+
+def test_stats_visible_via_state_api(cluster):
+    from ray_tpu.util.state import list_dataset_stats
+
+    ds = rdata.range(100).map_batches(lambda b: {"y": np.asarray(b["id"])})
+    list(ds.iter_batches(batch_size=25))
+    entries = list_dataset_stats()
+    assert entries, "dataset stats should be published to the state API"
+    assert any(any(op["rows_out"] >= 100 for op in e["ops"])
+               for e in entries)

@@ -1,0 +1,89 @@
+"""TFRecord / WebDataset / SQL / HuggingFace datasources (reference:
+python/ray/data/datasource/{tfrecords,webdataset,sql}_datasource.py)."""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data.datasources import (decode_example, encode_example,
+                                      write_tfrecords, write_webdataset)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 4.0})
+    yield
+    ray_tpu.shutdown()
+
+
+def test_example_proto_roundtrip():
+    row = {"label": 3, "weights": [0.5, 1.5], "name": b"cat",
+           "ids": [1, 2, 300000]}
+    out = decode_example(encode_example(row))
+    assert out["label"] == 3
+    assert out["ids"] == [1, 2, 300000]
+    assert out["name"] == b"cat"
+    assert out["weights"] == pytest.approx([0.5, 1.5])
+
+
+def test_read_tfrecords(cluster, tmp_path):
+    rows = [{"i": i, "x": float(i) / 2, "tag": f"r{i}".encode()}
+            for i in range(20)]
+    write_tfrecords(rows[:10], str(tmp_path / "a.tfrecords"))
+    write_tfrecords(rows[10:], str(tmp_path / "b.tfrecords"))
+    ds = rdata.read_tfrecords(str(tmp_path))
+    out = sorted(ds.take_all(), key=lambda r: r["i"])
+    assert len(out) == 20
+    assert out[5]["tag"] == b"r5"
+    assert out[7]["x"] == pytest.approx(3.5)
+
+
+@pytest.mark.skipif(
+    __import__("importlib").util.find_spec("tensorflow") is None,
+    reason="tensorflow not in image")
+def test_tfrecords_tensorflow_compat(tmp_path):
+    import tensorflow as tf
+
+    write_tfrecords([{"v": 7}], str(tmp_path / "c.tfrecords"))
+    recs = list(tf.data.TFRecordDataset(str(tmp_path / "c.tfrecords")))
+    ex = tf.train.Example.FromString(recs[0].numpy())
+    assert ex.features.feature["v"].int64_list.value[0] == 7
+
+
+def test_read_webdataset(cluster, tmp_path):
+    rows = [{"__key__": f"s{i:03d}", "txt": f"caption {i}",
+             "bin": bytes([i] * 4)} for i in range(6)]
+    write_webdataset(rows[:3], str(tmp_path / "shard0.tar"))
+    write_webdataset(rows[3:], str(tmp_path / "shard1.tar"))
+    ds = rdata.read_webdataset(str(tmp_path))
+    out = sorted(ds.take_all(), key=lambda r: r["__key__"])
+    assert len(out) == 6
+    assert out[2]["txt"] == "caption 2"
+    assert out[4]["bin"] == bytes([4] * 4)
+
+
+def test_read_sql(cluster, tmp_path):
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE pets (name TEXT, age INT)")
+    conn.executemany("INSERT INTO pets VALUES (?, ?)",
+                     [("rex", 3), ("ada", 7), ("bo", 1)])
+    conn.commit()
+    conn.close()
+    ds = rdata.read_sql("SELECT name, age FROM pets WHERE age > 2",
+                        lambda: sqlite3.connect(db))
+    out = sorted(ds.take_all(), key=lambda r: r["name"])
+    assert out == [{"name": "ada", "age": 7}, {"name": "rex", "age": 3}]
+
+
+def test_from_huggingface(cluster):
+    datasets = pytest.importorskip("datasets")
+    hf = datasets.Dataset.from_dict(
+        {"text": [f"t{i}" for i in range(8)], "label": list(range(8))})
+    ds = rdata.from_huggingface(hf)
+    out = sorted(ds.take_all(), key=lambda r: r["label"])
+    assert len(out) == 8 and out[3]["text"] == "t3"
