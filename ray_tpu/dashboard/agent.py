@@ -1,0 +1,159 @@
+"""Per-node agent: process/node stats + worker profiling.
+
+Reference: python/ray/dashboard/agent.py:23 + modules/reporter/ — the
+reference runs one agent process per node that samples every worker's
+cpu/rss via psutil, reports to the dashboard, and serves profiling requests
+(py-spy stack sampling, memray allocation tracking). Here the agent is a
+component hosted by the raylet (one fewer process per node, same surface):
+``collect()`` backs the extended ``GetNodeStats`` RPC, and the profiling
+half lives in every worker as RPC handlers (``ProfileStacks`` /
+``ProfileMemory``) backed by :func:`sample_stacks` — a cooperative
+stack-sampling profiler (sys._current_frames) and tracemalloc, the
+pure-Python equivalents of py-spy / memray that need no ptrace.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class NodeAgent:
+    """Collects node + per-worker process stats (reference:
+    dashboard/modules/reporter/reporter_agent.py)."""
+
+    def __init__(self):
+        self._boot = time.time()
+        self._procs: Dict[int, object] = {}  # pid -> psutil.Process
+
+    def collect(self, worker_pids: List[int]) -> dict:
+        import psutil
+
+        vm = psutil.virtual_memory()
+        try:
+            load1, load5, load15 = os.getloadavg()
+        except OSError:
+            load1 = load5 = load15 = 0.0
+        disk = psutil.disk_usage("/")
+        workers = []
+        seen = set()
+        for pid in worker_pids:
+            seen.add(pid)
+            try:
+                proc = self._procs.get(pid)
+                if proc is None:
+                    proc = psutil.Process(pid)
+                    proc.cpu_percent(interval=None)  # prime the counter
+                    self._procs[pid] = proc
+                with proc.oneshot():
+                    workers.append({
+                        "pid": pid,
+                        "cpu_percent": proc.cpu_percent(interval=None),
+                        "rss_mb": round(proc.memory_info().rss / 2**20, 1),
+                        "num_fds": proc.num_fds(),
+                        "num_threads": proc.num_threads(),
+                        "create_time": proc.create_time(),
+                    })
+            except Exception:
+                continue  # worker exited between listing and sampling
+        for pid in list(self._procs):
+            if pid not in seen:
+                del self._procs[pid]
+        return {
+            "uptime_s": round(time.time() - self._boot, 1),
+            "cpu_percent": psutil.cpu_percent(interval=None),
+            "mem_total_mb": round(vm.total / 2**20, 1),
+            "mem_available_mb": round(vm.available / 2**20, 1),
+            "mem_percent": vm.percent,
+            "load_avg": [load1, load5, load15],
+            "disk_percent": disk.percent,
+            "workers": workers,
+        }
+
+
+def sample_stacks(duration_s: float = 2.0, interval_ms: float = 10.0,
+                  target_thread_ids: Optional[List[int]] = None) -> dict:
+    """In-process stack-sampling profiler (the py-spy role, cooperatively).
+
+    A sampler thread snapshots ``sys._current_frames()`` every
+    ``interval_ms`` for ``duration_s`` and aggregates frames into folded
+    stacks ("a;b;c count" — the flamegraph input format py-spy emits with
+    --format raw). The sampler excludes itself.
+    """
+    import sys
+
+    folded: Dict[str, int] = {}
+    samples = 0
+    stop = time.monotonic() + max(0.05, duration_s)
+    me = threading.get_ident()
+    interval = max(0.001, interval_ms / 1000.0)
+    while time.monotonic() < stop:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            if target_thread_ids and tid not in target_thread_ids:
+                continue
+            stack = []
+            f = frame
+            depth = 0
+            while f is not None and depth < 64:
+                code = f.f_code
+                stack.append(f"{os.path.basename(code.co_filename)}:"
+                             f"{code.co_name}:{f.f_lineno}")
+                f = f.f_back
+                depth += 1
+            key = ";".join(reversed(stack))
+            folded[key] = folded.get(key, 0) + 1
+        samples += 1
+        time.sleep(interval)
+    top = sorted(folded.items(), key=lambda kv: -kv[1])
+    return {
+        "samples": samples,
+        "duration_s": duration_s,
+        "folded": dict(top[:500]),
+        "top": [{"stack": k.rsplit(";", 3)[-1], "count": v}
+                for k, v in top[:25]],
+    }
+
+
+class MemoryProfiler:
+    """tracemalloc wrapper (the memray role, allocation tracking)."""
+
+    def __init__(self):
+        self._running = False
+
+    def start(self, frames: int = 16):
+        import tracemalloc
+
+        if not self._running:
+            tracemalloc.start(frames)
+            self._running = True
+        return {"status": "started"}
+
+    def snapshot(self, top: int = 25) -> dict:
+        import tracemalloc
+
+        if not self._running:
+            return {"status": "not_running", "top": []}
+        snap = tracemalloc.take_snapshot()
+        stats = snap.statistics("traceback")
+        out = []
+        for st in stats[:top]:
+            out.append({
+                "size_kb": round(st.size / 1024, 1),
+                "count": st.count,
+                "traceback": [str(fr) for fr in st.traceback.format()[-6:]],
+            })
+        current, peak = tracemalloc.get_traced_memory()
+        return {"status": "ok", "current_kb": round(current / 1024, 1),
+                "peak_kb": round(peak / 1024, 1), "top": out}
+
+    def stop(self):
+        import tracemalloc
+
+        if self._running:
+            tracemalloc.stop()
+            self._running = False
+        return {"status": "stopped"}

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import json
-import pickle
+from ray_tpu._private import wire
 import time
 from typing import Optional
 
@@ -68,7 +68,7 @@ class DashboardHead:
     async def _call(self, method: str, req: dict) -> dict:
         if self._gcs is None:
             self._gcs = RetryingRpcClient(self.gcs_address)
-        return pickle.loads(await self._gcs.call(method, pickle.dumps(req)))
+        return wire.loads(await self._gcs.call(method, wire.dumps(req)))
 
     # -- lifecycle -----------------------------------------------------
 
@@ -86,6 +86,10 @@ class DashboardHead:
             web.get("/api/cluster_status", self._cluster_status),
             web.get("/api/summary", self._summary),
             web.get("/metrics", self._prometheus),
+            web.get("/api/nodes/{node_id}/stats", self._node_stats),
+            web.get("/api/data_stats", self._data_stats),
+            web.post("/api/profile/stacks", self._profile_stacks),
+            web.post("/api/profile/memory", self._profile_memory),
             web.get("/api/jobs", self._jobs_list),
             web.post("/api/jobs", self._jobs_submit),
             web.get("/api/jobs/{id}", self._job_info),
@@ -131,6 +135,73 @@ class DashboardHead:
 
         return web.json_response(
             (await self._call("ListActors", {}))["actors"])
+
+    async def _raylet(self, node_id: str):
+        """Cached client to one node's raylet (for agent stats/profiling)."""
+        if not hasattr(self, "_raylets"):
+            self._raylets = {}
+        client = self._raylets.get(node_id)
+        if client is None:
+            nodes = (await self._call("GetAllNodes", {}))["nodes"]
+            addr = next((n["address"] for n in nodes
+                         if n["node_id"].startswith(node_id) and n["alive"]),
+                        None)
+            if addr is None:
+                return None
+            client = RetryingRpcClient(addr)
+            self._raylets[node_id] = client
+        return client
+
+    async def _data_stats(self, request):
+        """Recent Dataset executions' per-op metrics (reference: the data
+        tab of the dashboard; fed by Dataset._publish_stats)."""
+        from aiohttp import web
+
+        keys = (await self._call("KVKeys",
+                                 {"ns": "data_stats", "prefix": ""}))["keys"]
+        out = []
+        for k in keys[-50:]:
+            blob = (await self._call("KVGet",
+                                     {"ns": "data_stats", "key": k}))["value"]
+            if blob is not None:
+                entry = wire.loads(blob)
+                entry["dataset"] = k
+                out.append(entry)
+        out.sort(key=lambda e: e.get("ts", 0))
+        return web.json_response(out)
+
+    async def _node_stats(self, request):
+        """Per-node agent sample: node cpu/mem/load + every worker's
+        cpu/rss/fds (reference: dashboard modules/reporter)."""
+        from aiohttp import web
+
+        client = await self._raylet(request.match_info["node_id"])
+        if client is None:
+            return web.json_response({"error": "unknown node"}, status=404)
+        stats = wire.loads(await client.call(
+            "GetNodeStats", wire.dumps({"agent": True}), timeout=30.0))
+        return web.json_response(stats)
+
+    async def _profile(self, request, kind: str):
+        from aiohttp import web
+
+        body = await request.json()
+        client = await self._raylet(str(body.get("node_id", "")))
+        if client is None:
+            return web.json_response({"error": "unknown node"}, status=404)
+        out = wire.loads(await client.call("ProfileWorker", wire.dumps({
+            "pid": int(body["pid"]), "kind": kind,
+            "args": body.get("args") or {},
+            "timeout": float(body.get("timeout", 60.0)),
+        }), timeout=float(body.get("timeout", 60.0)) + 10.0))
+        status = 200 if out.get("status") == "ok" else 404
+        return web.json_response(out, status=status)
+
+    async def _profile_stacks(self, request):
+        return await self._profile(request, "stacks")
+
+    async def _profile_memory(self, request):
+        return await self._profile(request, "memory")
 
     async def _pgs(self, request):
         from aiohttp import web
@@ -231,7 +302,7 @@ class DashboardHead:
             if blob is None:
                 continue
             try:
-                payload = pickle.loads(blob)
+                payload = wire.loads(blob)
             except Exception:
                 continue
             if time.time() - payload.get("time", 0) > 120:
@@ -262,7 +333,7 @@ class DashboardHead:
         for k in keys:
             blob = (await self._call("KVGet", {"ns": "job", "key": k}))["value"]
             if blob is not None:
-                out.append(pickle.loads(blob))
+                out.append(wire.loads(blob))
         return web.json_response(out)
 
     async def _job_info(self, request):
@@ -272,7 +343,7 @@ class DashboardHead:
         blob = (await self._call("KVGet", {"ns": "job", "key": sid}))["value"]
         if blob is None:
             return web.json_response({"error": f"no job {sid}"}, status=404)
-        return web.json_response(pickle.loads(blob))
+        return web.json_response(wire.loads(blob))
 
     async def _job_logs(self, request):
         from aiohttp import web

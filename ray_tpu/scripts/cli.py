@@ -158,6 +158,32 @@ def cmd_microbenchmark(args):
     ray_tpu.shutdown()
 
 
+def cmd_stack(args):
+    """`ray-tpu stack` (reference: `ray stack` / dashboard py-spy): sample a
+    worker's call stacks, or take a tracemalloc memory snapshot."""
+    _connect(args)
+    from ray_tpu.util.state import get_node_stats, list_nodes, profile_worker
+
+    nodes = [n for n in list_nodes() if n["alive"]]
+    node = next((n for n in nodes
+                 if n["node_id"].startswith(args.node or "")), None)
+    if node is None:
+        print(f"no node matching {args.node!r}")
+        return
+    if args.pid is None:
+        stats = get_node_stats(node["address"], agent=True)
+        for w in stats["agent"]["workers"]:
+            print(json.dumps(w))
+        return
+    if args.memory:
+        out = profile_worker(node["address"], args.pid, kind="memory",
+                             action=args.memory_action)
+    else:
+        out = profile_worker(node["address"], args.pid, kind="stacks",
+                             duration_s=args.duration)
+    print(json.dumps(out.get("profile", out), indent=1))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray-tpu")
     parser.add_argument("--address", default="")
@@ -208,6 +234,16 @@ def main(argv=None):
     p.add_argument("--duration", type=float, default=2.0)
     p.add_argument("--num-cpus", type=float, default=None)
     p.set_defaults(fn=cmd_microbenchmark)
+
+    p = sub.add_parser("stack", help="profile a worker (stacks or memory)")
+    p.add_argument("--node", default="", help="node id prefix (default: head)")
+    p.add_argument("--pid", type=int, default=None,
+                   help="worker pid (omit to list workers)")
+    p.add_argument("--duration", type=float, default=2.0)
+    p.add_argument("--memory", action="store_true")
+    p.add_argument("--memory-action", default="snapshot",
+                   choices=["start", "snapshot", "stop"])
+    p.set_defaults(fn=cmd_stack)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -3,6 +3,7 @@ list_* :790-1304, backed by the GCS instead of a dashboard process)."""
 
 from __future__ import annotations
 
+from ray_tpu._private import wire
 from typing import Any, Dict, List, Optional
 
 
@@ -31,18 +32,61 @@ def list_placement_groups() -> List[Dict[str, Any]]:
     return _state()["pgs"]
 
 
-def get_node_stats(node_address: str) -> Dict[str, Any]:
-    import pickle
-
+def get_node_stats(node_address: str, agent: bool = False) -> Dict[str, Any]:
+    """Raylet-side stats; agent=True adds the per-node agent sample (node
+    cpu/mem/load + per-worker cpu/rss, reference: dashboard
+    modules/reporter)."""
     from ray_tpu._private import worker as worker_mod
 
     core = worker_mod.global_worker()
     client = core._raylet_client(node_address)
 
     async def _call():
-        return pickle.loads(await client.call("GetNodeStats", b""))
+        return wire.loads(await client.call(
+            "GetNodeStats", wire.dumps({"agent": agent}), timeout=30.0))
 
     return core._run(_call())
+
+
+def profile_worker(node_address: str, pid: int, kind: str = "stacks",
+                   **args) -> Dict[str, Any]:
+    """Profile one worker process on a node (reference: `ray stack` /
+    dashboard py-spy + memray integration). kind="stacks" samples folded
+    call stacks; kind="memory" drives the tracemalloc profiler with
+    args={"action": "start"|"snapshot"|"stop", ...}."""
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod.global_worker()
+    client = core._raylet_client(node_address)
+    timeout = float(args.pop("timeout", 60.0))
+
+    async def _call():
+        return wire.loads(await client.call("ProfileWorker", wire.dumps({
+            "pid": pid, "kind": kind, "args": args, "timeout": timeout,
+        }), timeout=timeout + 10.0))
+
+    return core._run(_call(), timeout + 15.0)
+
+
+def list_dataset_stats() -> List[Dict[str, Any]]:
+    """Per-op runtime metrics of recent Dataset executions (reference:
+    data stats surfaced in the dashboard; populated by
+    Dataset._publish_stats via GCS KV ns="data_stats")."""
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod.global_worker()
+    keys = core._run(core._gcs_call(
+        "KVKeys", {"ns": "data_stats", "prefix": ""}))["keys"]
+    out = []
+    for k in keys:
+        blob = core._run(core._gcs_call(
+            "KVGet", {"ns": "data_stats", "key": k}))["value"]
+        if blob is not None:
+            entry = wire.loads(blob)
+            entry["dataset"] = k
+            out.append(entry)
+    out.sort(key=lambda e: e.get("ts", 0))
+    return out
 
 
 def summarize_cluster() -> Dict[str, Any]:
