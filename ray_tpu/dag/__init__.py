@@ -25,6 +25,22 @@ class DAGNode:
     def __init__(self, args: tuple, kwargs: dict):
         self._bound_args = args
         self._bound_kwargs = kwargs
+        self._transport: Optional[dict] = None
+
+    def with_tensor_transport(self, transport: str = "ici", *,
+                              shift: int = 1) -> "DAGNode":
+        """Annotate this node's OUTGOING edges (reference:
+        DAGNode.with_tensor_transport / with_type_hint). transport="ici"
+        lowers same-actor edges to a compiled shard_map ppermute over the
+        actor's mesh (dag/device_channel.py) — the hand-off rides ICI
+        inside the compiled program instead of the host channel plane.
+        Cross-actor edges fall back to the channel plane (multi-controller
+        slice actors execute the same compiled step on device instead)."""
+        if transport not in ("ici", "object"):
+            raise ValueError(f"unknown tensor transport {transport!r}")
+        self._transport = None if transport == "object" else {
+            "type": transport, "shift": shift}
+        return self
 
     def _deps(self) -> List["DAGNode"]:
         out = []
@@ -280,7 +296,16 @@ class CompiledDAG:
                                      (self._input_chan_name, v._index)))
                 elif isinstance(v, ClassMethodNode):
                     if self._actor_of(v) == actor:
-                        arg_spec.append(("local", sched["node_idx"][id(v)]))
+                        tp = getattr(v, "_transport", None)
+                        if tp and tp.get("type") == "ici":
+                            # compiled ICI hop: the producer's sharded
+                            # output shifts one mesh position inside a
+                            # jitted ppermute (device_channel.IciTransfer)
+                            arg_spec.append(("local_ici", (
+                                sched["node_idx"][id(v)], tp.get("shift", 1))))
+                        else:
+                            arg_spec.append(
+                                ("local", sched["node_idx"][id(v)]))
                     else:
                         cname = chan_of[id(v)]
                         note_reader(cname, sched)

@@ -89,6 +89,15 @@ class DagLoopRunner:
                     value = chan_value(v[0])
                     if not isinstance(value, (_Stop, ChannelError)):
                         value = value[v[1]]
+                elif kind == "local_ici":
+                    # compiled ICI edge: move the upstream op's sharded
+                    # output to this stage's mesh position via the cached
+                    # jitted ppermute (reference: accelerator channels)
+                    value = locals_[v[0]]
+                    if not isinstance(value, (_Stop, ChannelError)):
+                        from ray_tpu.dag.device_channel import get_transfer
+
+                        value = get_transfer(self.instance, v[1])(value)
                 else:  # local
                     value = locals_[v]
                 if isinstance(value, _Stop):
