@@ -535,6 +535,9 @@ class CoreWorker:
     ):
         self.gcs_address = gcs_address
         self.raylet_address = raylet_address
+        # owner-resident object directory (locations of objects this
+        # worker owns, fed by raylet seal announcements)
+        self._obj_locations: Dict[bytes, dict] = {}
         self.node_id = node_id
         self.is_driver = is_driver
         self.namespace = namespace
@@ -896,10 +899,11 @@ class CoreWorker:
         self.ref_counter.pin_nested(oid.binary(), inner)
 
     async def _store_blob(self, oid: ObjectID, inband: bytes, buffers,
-                          attempt: int = 0):
+                          attempt: int = 0, owner: str = ""):
         total, offsets = plan_layout(inband, buffers)
         reply = wire.loads(await self.raylet.call("StoreCreate", wire.dumps(
-            {"oid": oid.binary(), "size": total, "attempt": attempt})))
+            {"oid": oid.binary(), "size": total, "attempt": attempt,
+             "owner": owner or self.address})))
         if reply["status"] in ("exists", "stale_attempt"):
             # seal-once: the id is already (or about to be) bound to a value
             # for this or a newer execution epoch; this writer stands down
@@ -922,10 +926,10 @@ class CoreWorker:
             {"oid": oid.binary(), "attempt": attempt}))
 
     async def _read_local_store(self, oid: ObjectID, timeout: float, pull=True,
-                                prio: int = 0):
+                                prio: int = 0, owner: str = ""):
         reply = wire.loads(await self.raylet.call("StoreGet", wire.dumps(
             {"oid": oid.binary(), "timeout": timeout, "pull": pull,
-             "prio": prio}),
+             "prio": prio, "owner": owner}),
             timeout=timeout + 10.0))
         status = reply["status"]
         if status == "inline":
@@ -965,7 +969,8 @@ class CoreWorker:
             # 3. known to live in the distributed store
             if self._in_store.get(oid):
                 ok, value = await self._read_local_store(
-                    oid, max(0.1, deadline - time.monotonic()), prio=prio)
+                    oid, max(0.1, deadline - time.monotonic()), prio=prio,
+                    owner=ref.owner_address())
                 if ok:
                     return value
                 # lost from the store (e.g. the holding node died):
@@ -983,7 +988,8 @@ class CoreWorker:
                 lost_hint = False
                 if in_store:
                     ok, value = await self._read_local_store(
-                        oid, max(0.1, deadline - time.monotonic()), prio=prio)
+                        oid, max(0.1, deadline - time.monotonic()), prio=prio,
+                        owner=ref.owner_address())
                     if ok:
                         return value
                     # tell the owner on the next round so it can verify and
@@ -994,7 +1000,7 @@ class CoreWorker:
             # 5. last resort: the store via directory pull
             ok, value = await self._read_local_store(
                 oid, max(0.1, min(deadline - time.monotonic(), 5.0)),
-                prio=prio)
+                prio=prio, owner=ref.owner_address())
             if ok:
                 return value
             if time.monotonic() > deadline:
@@ -1182,6 +1188,8 @@ class CoreWorker:
                     freed_in_store.append(r.binary())
                 self.ref_counter.release_nested(r.binary())
                 oids.append(r.binary())
+            for ob in oids:
+                self._obj_locations.pop(ob, None)
             if freed_in_store:
                 try:
                     await self._gcs_call("ObjectFree", {"oids": freed_in_store})
@@ -1236,6 +1244,7 @@ class CoreWorker:
         self._result_futures.pop(oid, None)
         in_store = self._in_store.pop(oid, None)
         rc.release_nested(oid_bytes)
+        self._obj_locations.pop(oid_bytes, None)
         if in_store:
             try:
                 await self._gcs_call("ObjectFree", {"oids": [oid_bytes]})
@@ -1682,6 +1691,15 @@ class CoreWorker:
         now = time.monotonic()
         for oid, _owner in arg_refs:
             key = oid.binary() if hasattr(oid, "binary") else oid
+            own = self._obj_locations.get(key)
+            if own is not None:
+                # owner-resident: this worker owns the object — its own
+                # table answers without any directory RPC
+                size = own.get("size", 0) or 0
+                for n, a in own["nodes"].items():
+                    by_node[n] = by_node.get(n, 0) + size
+                    addr_of[n] = a
+                continue
             hit = self._loc_cache.get(key)
             if hit is not None and now - hit[0] < 5.0:
                 reply = hit[1]
@@ -2205,6 +2223,49 @@ class CoreWorker:
             return wire.dumps({"results": results})
         if method == "GetOwnedObject":
             return await self._handle_get_owned(wire.loads(payload))
+        if method == "ObjectLocAnnounce":
+            # owner-resident directory write (reference:
+            # ownership_object_directory.cc): raylets report seals of
+            # objects this worker owns — batched per announce, same
+            # attempt-fencing as the GCS directory. Best-effort: the GCS
+            # keeps the durable copy.
+            req = wire.loads(payload)
+            tab = self._obj_locations
+            attempt = req.get("attempt", 0)
+            sizes = req.get("sizes") or {}
+            node, addr = req["node_id"], req["address"]
+            for ob in req["oids"]:
+                entry = tab.get(ob)
+                size = sizes.get(ob, 0) or 0
+                if entry is None or attempt > entry["attempt"]:
+                    tab[ob] = {"attempt": attempt, "size": size,
+                               "nodes": {node: addr}}
+                    if len(tab) > 65536:  # safety bound; GCS is fallback
+                        tab.pop(next(iter(tab)))
+                elif attempt == entry["attempt"]:
+                    entry["nodes"][node] = addr
+                    if size:
+                        entry["size"] = size
+            return wire.dumps({"status": "ok"})
+        if method == "ObjectLocDrop":
+            req = wire.loads(payload)
+            entry = self._obj_locations.get(req["oid"])
+            if entry is not None:
+                entry["nodes"].pop(req["node_id"], None)
+                if not entry["nodes"]:
+                    self._obj_locations.pop(req["oid"], None)
+            return wire.dumps({"status": "ok"})
+        if method == "ObjectLocQuery":
+            # owner-resident directory read: the pulling raylet asks the
+            # owner, not the GCS (falls back there if we have nothing)
+            req = wire.loads(payload)
+            entry = self._obj_locations.get(req["oid"])
+            if entry is None:
+                return wire.dumps({"locations": [], "attempt": 0, "size": 0})
+            return wire.dumps({
+                "locations": [{"node_id": n, "address": a}
+                              for n, a in entry["nodes"].items()],
+                "attempt": entry["attempt"], "size": entry.get("size", 0)})
         if method == "AddBorrower":
             req = wire.loads(payload)
             self.ref_counter.add_borrower(req["oid"], req["address"])
@@ -2576,7 +2637,8 @@ class CoreWorker:
                            "kind": "inline", "attempt": spec.attempt,
                            "blob": pack_blob(inband, buffers)}
             else:
-                await self._store_blob(oid, inband, buffers, spec.attempt)
+                await self._store_blob(oid, inband, buffers, spec.attempt,
+                                       owner=spec.owner_address)
                 payload = {"task_id": tid_b, "index": index,
                            "kind": "store", "attempt": spec.attempt}
             await owner.call("StreamTaskReturn", wire.dumps(payload),
@@ -2619,7 +2681,8 @@ class CoreWorker:
                            "kind": "inline", "attempt": spec.attempt,
                            "blob": pack_blob(inband, buffers)}
             else:
-                await self._store_blob(oid, inband, buffers, spec.attempt)
+                await self._store_blob(oid, inband, buffers, spec.attempt,
+                                       owner=spec.owner_address)
                 payload = {"task_id": tid_b, "index": index,
                            "kind": "store", "attempt": spec.attempt}
             await owner.call("StreamTaskReturn", wire.dumps(payload),
@@ -2816,7 +2879,8 @@ class CoreWorker:
                 # inline values are rehydrated in the owner's memory store;
                 # the live inner refs there carry the counts
             else:
-                await self._store_blob(oid, inband, buffers, spec.attempt)
+                await self._store_blob(oid, inband, buffers, spec.attempt,
+                                       owner=spec.owner_address)
                 results.append(("store", None))
                 if inner:
                     # stored blobs hold refs only as bytes: the owner must

@@ -193,7 +193,7 @@ def pack_blob(inband: bytes, buffers: List[memoryview]) -> bytes:
 class _Entry:
     __slots__ = (
         "state", "shm", "shm_name", "size", "last_access", "spill_path", "inline",
-        "arena_offset", "attempt", "arena_key",
+        "arena_offset", "attempt", "arena_key", "owner",
     )
 
     def __init__(self):
@@ -204,6 +204,7 @@ class _Entry:
         self.last_access = time.monotonic()
         self.spill_path = ""
         self.inline: Optional[bytes] = None
+        self.owner = ""  # owner worker address (owner-resident directory)
         self.arena_offset: Optional[int] = None  # set when backed by the arena
         # execution-epoch fence (reference: plasma's seal-once semantics,
         # obj_lifecycle_mgr.cc — here generalized so a retried task's newer
@@ -350,7 +351,8 @@ class ObjectStoreServer:
 
     # -- operations (all called on the raylet event loop) --
 
-    def create(self, oid: bytes, size: int, attempt: int = 0) -> dict:
+    def create(self, oid: bytes, size: int, attempt: int = 0,
+               owner: str = "") -> dict:
         existing = self.objects.get(oid)
         if existing is not None:
             if attempt < existing.attempt:
@@ -366,6 +368,7 @@ class ObjectStoreServer:
         e = _Entry()
         e.size = size
         e.attempt = attempt
+        e.owner = owner
         if self.arena is not None:
             e.arena_key = self._arena_key(oid, attempt)
             off = self.arena.alloc(e.arena_key, size)
@@ -400,7 +403,8 @@ class ObjectStoreServer:
         if e.spill_path:
             self.storage.delete(e.spill_path)
 
-    def put_inline(self, oid: bytes, blob: bytes, attempt: int = 0) -> bool:
+    def put_inline(self, oid: bytes, blob: bytes, attempt: int = 0,
+                   owner: str = "") -> bool:
         existing = self.objects.get(oid)
         if existing is not None:
             if attempt < existing.attempt:
@@ -413,6 +417,7 @@ class ObjectStoreServer:
         e.size = len(blob)
         e.state = "SEALED"
         e.attempt = attempt
+        e.owner = owner
         self.objects[oid] = e
         self._wake(oid)
         return True
@@ -490,6 +495,10 @@ class ObjectStoreServer:
         if e.state == "SPILLED":
             return self.storage.restore_range(e.spill_path, offset, length)
         return bytes(self._region(e)[offset : offset + length])
+
+    def object_owner(self, oid: bytes) -> str:
+        e = self.objects.get(oid)
+        return e.owner if e is not None else ""
 
     def object_size(self, oid: bytes) -> Optional[int]:
         e = self.objects.get(oid)

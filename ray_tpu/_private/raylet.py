@@ -766,7 +766,9 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def _rpc_StoreCreate(self, req, conn):
-        return self.store.create(req["oid"], req["size"], req.get("attempt", 0))
+        return self.store.create(req["oid"], req["size"],
+                                 req.get("attempt", 0),
+                                 owner=req.get("owner", ""))
 
     async def _rpc_StoreSeal(self, req, conn):
         attempt = req.get("attempt", 0)
@@ -777,7 +779,8 @@ class Raylet:
 
     async def _rpc_StorePutInline(self, req, conn):
         attempt = req.get("attempt", 0)
-        if not self.store.put_inline(req["oid"], req["blob"], attempt):
+        if not self.store.put_inline(req["oid"], req["blob"], attempt,
+                                     owner=req.get("owner", "")):
             return {"status": "stale_attempt"}
         asyncio.ensure_future(self._announce([req["oid"]], attempt))
         return {"status": "ok"}
@@ -798,6 +801,48 @@ class Raylet:
                  "attempt": attempt}), retries=2)
         except (RpcError, asyncio.TimeoutError, OSError):
             logger.warning("failed to announce %d object locations", len(oids))
+        # owner-resident directory (reference:
+        # ownership_object_directory.cc): the owner serves location READS
+        # for its objects, so pulls stop hammering the GCS; the GCS copy
+        # above remains the durable fallback. One batched RPC per owner,
+        # mirroring the batched GCS announce.
+        by_owner: Dict[str, list] = {}
+        for o in oids:
+            owner = self.store.object_owner(o)
+            if owner:
+                by_owner.setdefault(owner, []).append(o)
+        for owner, group in by_owner.items():
+            asyncio.ensure_future(self._notify_owner(owner, "ObjectLocAnnounce", {
+                "oids": group, "node_id": self.node_id.hex(),
+                "address": self.server.address,
+                "sizes": {o: self.store.object_size(o) or 0 for o in group},
+                "attempt": attempt}))
+
+    async def _notify_owner(self, owner: str, method: str, msg: dict):
+        try:
+            await self._owner_client(owner).call(
+                method, wire.dumps(msg), timeout=10.0, retries=1)
+        except (RpcError, asyncio.TimeoutError, OSError):
+            pass  # best-effort: the GCS directory still has it
+
+    def _owner_client(self, addr: str) -> RetryingRpcClient:
+        from collections import OrderedDict
+
+        cache = getattr(self, "_owner_clients", None)
+        if cache is None:
+            cache = self._owner_clients = OrderedDict()
+        client = cache.get(addr)
+        if client is None:
+            if len(cache) > 128:
+                _, evicted = cache.popitem(last=False)  # LRU, not newest
+                # grace before close: a concurrent notify/query may still
+                # be awaiting on this client
+                asyncio.get_event_loop().call_later(
+                    30.0, lambda c=evicted: asyncio.ensure_future(c.close()))
+            client = cache[addr] = RetryingRpcClient(addr)
+        else:
+            cache.move_to_end(addr)
+        return client
 
     async def _rpc_StoreGet(self, req, conn):
         oid = req["oid"]
@@ -806,7 +851,8 @@ class Raylet:
         if pulling:
             # priority class rides the request: 0 = blocked get, 1 = task
             # arg, 2 = background (reference: pull_manager.cc priorities)
-            self._ensure_pull(oid, prio=int(req.get("prio", 1)))
+            self._ensure_pull(oid, prio=int(req.get("prio", 1)),
+                              owner=req.get("owner", ""))
             self._pull_queue.add_waiter(oid)
         try:
             ok = await self.store.wait_local(oid, timeout)
@@ -822,7 +868,8 @@ class Raylet:
 
     async def _rpc_StoreMeta(self, req, conn):
         size = self.store.object_size(req["oid"])
-        return {"size": size, "attempt": self.store.object_attempt(req["oid"])}
+        return {"size": size, "attempt": self.store.object_attempt(req["oid"]),
+                "owner": self.store.object_owner(req["oid"])}
 
     async def _rpc_StoreFetchChunk(self, req, conn):
         data = self.store.read_chunk(req["oid"], req["offset"], req["length"],
@@ -830,24 +877,30 @@ class Raylet:
         return {"data": data}
 
     async def _rpc_StoreDelete(self, req, conn):
+        owners = {o: self.store.object_owner(o) for o in req["oids"]}
         self.store.delete(req["oids"])
         try:
             await self.gcs.call("ObjectLocRemove", wire.dumps(
                 {"oids": req["oids"], "node_id": self.node_id}), retries=1)
         except (RpcError, asyncio.TimeoutError, OSError):
             pass
+        for o, owner in owners.items():
+            if owner:  # keep the owner-resident view from going stale
+                asyncio.ensure_future(self._notify_owner(
+                    owner, "ObjectLocDrop",
+                    {"oid": o, "node_id": self.node_id.hex()}))
         return {"status": "ok"}
 
     async def _rpc_StoreStats(self, req, conn):
         return self.store.stats()
 
-    def _ensure_pull(self, oid: bytes, prio: int = 1):
+    def _ensure_pull(self, oid: bytes, prio: int = 1, owner: str = ""):
         self._pull_queue.request(oid, prio)  # registers or upgrades
         if oid in self._pulls and not self._pulls[oid].done():
             return
-        self._pulls[oid] = asyncio.ensure_future(self._pull(oid, prio))
+        self._pulls[oid] = asyncio.ensure_future(self._pull(oid, prio, owner))
 
-    async def _pull(self, oid: bytes, prio: int = 1):
+    async def _pull(self, oid: bytes, prio: int = 1, owner: str = ""):
         """Chunked transfer from a remote node's store (reference:
         object_manager/pull_manager.cc + push_manager.cc). Bounded
         concurrency (FIFO through a semaphore) keeps a burst of pulls from
@@ -856,9 +909,9 @@ class Raylet:
         announces a new location, an N-node broadcast forms an organic
         fan-out tree off the origin instead of an N-deep queue on it
         (reference: the 1 GiB / 50-node broadcast envelope)."""
-        await self._pull_inner(oid, prio)
+        await self._pull_inner(oid, prio, owner)
 
-    async def _pull_inner(self, oid: bytes, prio: int = 1):
+    async def _pull_inner(self, oid: bytes, prio: int = 1, owner: str = ""):
         import random as _random
 
         deadline = time.monotonic() + RAY_CONFIG.object_pull_timeout_s
@@ -866,14 +919,32 @@ class Raylet:
         while time.monotonic() < deadline:
             if self.store.contains(oid):
                 return
-            try:
-                reply = wire.loads(await self.gcs.call(
-                    "ObjectLocGet", wire.dumps({"oid": oid}), retries=2))
-            except (RpcError, asyncio.TimeoutError, OSError):
-                await asyncio.sleep(0.2)
-                continue
+            reply = None
+            if owner and owner != "gcs-only":
+                # owner-resident directory read; an unreachable or empty
+                # owner drops us to the GCS copy for the rest of this pull
+                try:
+                    reply = wire.loads(await self._owner_client(owner).call(
+                        "ObjectLocQuery", wire.dumps({"oid": oid}),
+                        timeout=10.0, retries=1))
+                    if not reply.get("locations"):
+                        reply = None
+                        owner = "gcs-only"
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    reply = None
+                    owner = "gcs-only"
+            if reply is None:
+                try:
+                    reply = wire.loads(await self.gcs.call(
+                        "ObjectLocGet", wire.dumps({"oid": oid}), retries=2))
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    await asyncio.sleep(0.2)
+                    continue
             locations = [l for l in reply["locations"] if l["node_id"] != self.node_id.hex()]
             if not locations:
+                # nothing usable this round (possibly a stale owner view
+                # listing only us): consult the GCS copy from here on
+                owner = "gcs-only"
                 await asyncio.sleep(0.1)
                 continue
             locations[0] = _random.choice(locations)
@@ -902,6 +973,10 @@ class Raylet:
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 logger.warning("pull %s from %s failed: %s", oid.hex()[:12],
                                locations[0]["address"], e)
+                # the copy the owner pointed us at is gone/unreachable;
+                # the GCS may know a live secondary — stop re-asking the
+                # owner for this pull
+                owner = "gcs-only"
                 self._pull_queue.request(oid, prio)
                 await asyncio.sleep(0.2)
             finally:
@@ -914,7 +989,11 @@ class Raylet:
         if size is None:
             raise _PullRetry()
         attempt = meta.get("attempt", 0)
-        created = self.store.create(oid, size, attempt)
+        # carry the owner onto the pulled copy: this node's seal announce
+        # then reaches the owner too, so secondary replicas join the
+        # owner-resident directory and broadcast trees fan out there as well
+        created = self.store.create(oid, size, attempt,
+                                    owner=meta.get("owner", ""))
         if created["status"] in ("exists", "stale_attempt"):
             return
         if created["status"] != "ok":
