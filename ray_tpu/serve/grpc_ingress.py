@@ -1,0 +1,195 @@
+"""gRPC ingress for Serve (reference: serve/_private/proxy.py gRPC side +
+serve/grpc_util.py).
+
+A generic-handler gRPC server (no generated stubs needed): requests are
+msgpack-encoded, routed to deployment handles exactly like the HTTP proxy.
+
+  unary    /ray_tpu.serve.ServeAPI/Call    {deployment, method?, body}
+  stream   /ray_tpu.serve.ServeAPI/Stream  same request, one message per
+                                           replica yield (token streaming)
+
+Runs inside a detached actor (`start_grpc_proxy`), sharing the controller
+topology through ordinary DeploymentHandles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu._private import wire
+
+SERVICE = "ray_tpu.serve.ServeAPI"
+
+# the typed wire codec round-trips numpy arrays, sets, and framework
+# structs losslessly (and refuses what it can't represent, instead of
+# silently stringifying it)
+_encode = wire.dumps
+_decode = wire.loads
+
+
+class _ServeGrpcHandler:
+    """grpc.GenericRpcHandler routing to deployment handles."""
+
+    def __init__(self):
+        import grpc
+
+        self._grpc = grpc
+        self._handles: Dict[tuple, Any] = {}
+
+    def _handle(self, name: str, method: str, stream: bool):
+        from ray_tpu.serve.api import DeploymentHandle, _get_controller
+
+        key = (name, method, stream)
+        h = self._handles.get(key)
+        if h is None:
+            # validate the name against the controller first: an unknown
+            # deployment must NOT-FOUND immediately instead of pinning a
+            # worker thread in the handle's replica-wait loop
+            controller = _get_controller(create=False)
+            try:
+                ray_tpu.get(controller.get_topology.remote(name),
+                            timeout=10.0)
+            except Exception:
+                raise LookupError(f"no deployment named {name!r}")
+            h = DeploymentHandle(name, method_name=method or "__call__",
+                                 stream=stream)
+            self._handles[key] = h
+        return h
+
+    def service(self, handler_call_details):
+        grpc = self._grpc
+        method = handler_call_details.method
+        if method == f"/{SERVICE}/Call":
+            return grpc.unary_unary_rpc_method_handler(
+                self._call, request_deserializer=_decode,
+                response_serializer=_encode)
+        if method == f"/{SERVICE}/Stream":
+            return grpc.unary_stream_rpc_method_handler(
+                self._stream, request_deserializer=_decode,
+                response_serializer=_encode)
+        if method == f"/{SERVICE}/Healthz":
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: {"ok": True},
+                request_deserializer=_decode, response_serializer=_encode)
+        return None
+
+    def _call(self, request, context):
+        grpc = self._grpc
+        try:
+            h = self._handle(request["deployment"],
+                             request.get("method", "__call__"), False)
+            result = ray_tpu.get(h.remote(request.get("body", {})),
+                                 timeout=float(request.get("timeout", 120.0)))
+            return {"result": result}
+        except LookupError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except KeyError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"missing field {e}")
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def _stream(self, request, context):
+        grpc = self._grpc
+        gen = None
+        try:
+            h = self._handle(request["deployment"],
+                             request.get("method", "__call__"), True)
+            timeout = float(request.get("timeout", 120.0))
+            gen = h.remote(request.get("body", {}))
+            for ref in gen:
+                if not context.is_active():
+                    # client went away: cancel the replica's generator so
+                    # it stops producing for a dead stream
+                    ray_tpu.cancel(gen)
+                    return
+                yield {"chunk": ray_tpu.get(ref, timeout=timeout)}
+        except LookupError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except KeyError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"missing field {e}")
+        except Exception as e:
+            if gen is not None and not context.is_active():
+                try:
+                    ray_tpu.cancel(gen)
+                except Exception:
+                    pass
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+
+@ray_tpu.remote
+class _GrpcProxy:
+    def __init__(self, port: int):
+        self.port = port
+        self._server = None
+        self._bound_port = 0
+
+    def start(self) -> int:
+        if self._server is not None:
+            return self._bound_port  # get_if_exists re-entry: already up
+        from concurrent import futures
+
+        import grpc
+
+        handler = _ServeGrpcHandler()
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, details):
+                return handler.service(details)
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16),
+            handlers=(_Generic(),))
+        bound = self._server.add_insecure_port(f"127.0.0.1:{self.port}")
+        if bound == 0:
+            self._server = None
+            raise RuntimeError(f"cannot bind gRPC ingress to port {self.port}")
+        self._server.start()
+        self._bound_port = bound
+        return bound
+
+
+def start_grpc_proxy(port: int = 0) -> int:
+    """Start (or reuse) the detached gRPC ingress actor; returns the bound
+    port."""
+    proxy = _GrpcProxy.options(
+        name="serve_grpc_proxy", lifetime="detached", num_cpus=0.1,
+        max_concurrency=32, get_if_exists=True).remote(port)
+    return ray_tpu.get(proxy.start.remote(), timeout=120)
+
+
+class ServeGrpcClient:
+    """Minimal client for the generic ingress (tests / SDK use)."""
+
+    def __init__(self, address: str):
+        import grpc
+
+        self._channel = grpc.insecure_channel(address)
+        self._call = self._channel.unary_unary(
+            f"/{SERVICE}/Call", request_serializer=_encode,
+            response_deserializer=_decode)
+        self._stream = self._channel.unary_stream(
+            f"/{SERVICE}/Stream", request_serializer=_encode,
+            response_deserializer=_decode)
+
+    def call(self, deployment: str, body: Optional[dict] = None,
+             method: str = "__call__", timeout: float = 120.0):
+        return self._call({"deployment": deployment, "method": method,
+                           "body": body or {}, "timeout": timeout},
+                          timeout=timeout + 10.0)["result"]
+
+    def stream(self, deployment: str, body: Optional[dict] = None,
+               method: str = "__call__", timeout: float = 120.0,
+               overall_timeout: Optional[float] = None):
+        """`timeout` is the server's PER-CHUNK budget; the gRPC deadline
+        for the whole stream is only set when `overall_timeout` is given —
+        a healthy long token stream must not be killed client-side."""
+        for msg in self._stream({"deployment": deployment, "method": method,
+                                 "body": body or {}, "timeout": timeout},
+                                timeout=overall_timeout):
+            yield msg["chunk"]
+
+    def close(self):
+        self._channel.close()

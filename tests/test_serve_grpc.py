@@ -1,0 +1,75 @@
+"""Serve gRPC ingress (reference: serve/_private/proxy.py gRPC proxy):
+unary and server-streaming routing to deployments over a generic handler."""
+
+import time
+
+import pytest
+
+pytest.importorskip("grpc")
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.grpc_ingress import ServeGrpcClient, start_grpc_proxy
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(resources={"CPU": 6.0})
+    yield
+    try:
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+def test_grpc_unary_and_stream(cluster):
+    @serve.deployment
+    class Api:
+        def __call__(self, body):
+            return {"sum": sum(body.get("xs", []))}
+
+        async def tokens(self, body):
+            import asyncio
+
+            for i in range(int(body.get("n", 3))):
+                await asyncio.sleep(0.2)
+                yield {"tok": i}
+
+    serve.run(Api.bind(), name="api")
+    port = start_grpc_proxy()
+    client = ServeGrpcClient(f"127.0.0.1:{port}")
+    try:
+        assert client.call("api", {"xs": [1, 2, 3]}) == {"sum": 6}
+
+        t0 = time.monotonic()
+        first_at = None
+        chunks = []
+        for chunk in client.stream("api", {"n": 3}, method="tokens"):
+            if first_at is None:
+                first_at = time.monotonic() - t0
+            chunks.append(chunk)
+        assert chunks == [{"tok": 0}, {"tok": 1}, {"tok": 2}]
+        assert first_at < 0.55, f"stream not incremental: {first_at:.2f}s"
+    finally:
+        client.close()
+        serve.delete("api")
+
+
+def test_grpc_unknown_deployment_errors(cluster):
+    import grpc
+
+    @serve.deployment
+    def noop(body):
+        return 1
+
+    serve.run(noop.bind(), name="noop")
+    port = start_grpc_proxy()
+    client = ServeGrpcClient(f"127.0.0.1:{port}")
+    try:
+        with pytest.raises(grpc.RpcError):
+            client.call("no-such-deployment", {}, timeout=15.0)
+    finally:
+        client.close()
+        serve.delete("noop")
