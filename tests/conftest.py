@@ -98,6 +98,15 @@ def pytest_runtest_protocol(item, nextitem):
     for rep in reports:
         hook.pytest_runtest_logreport(report=rep)
     hook.pytest_runtest_logfinish(nodeid=item.nodeid, location=item.location)
+    # the default protocol ends every item with teardown_exact(nextitem),
+    # popping module/class fixtures the next item doesn't need. Skipping it
+    # here leaves the previous module's finalizers on the setup stack and
+    # the NEXT file's first test dies with "previous item was not torn
+    # down properly".
+    try:
+        item.session._setupstate.teardown_exact(nextitem)
+    except Exception:
+        pass
     return True
 
 

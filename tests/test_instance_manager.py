@@ -1,0 +1,158 @@
+"""Autoscaler v2 instance lifecycle (reference:
+python/ray/autoscaler/v2/instance_manager/ + its unit tests)."""
+
+import pytest
+
+from ray_tpu.autoscaler.config import NodeTypeConfig
+from ray_tpu.autoscaler.instance_manager import (ALLOCATED,
+                                                 ALLOCATION_FAILED, QUEUED,
+                                                 RAY_RUNNING, RAY_STOPPING,
+                                                 REQUESTED, TERMINATED,
+                                                 TERMINATING,
+                                                 InstanceManager,
+                                                 InvalidTransition)
+
+
+class FakeProvider:
+    """Synchronous fake with controllable failures."""
+
+    def __init__(self, fail_launches: int = 0):
+        self.nodes = {}
+        self._n = 0
+        self.fail_launches = fail_launches
+        self.terminated = []
+
+    def create_nodes(self, node_type, count):
+        if self.fail_launches > 0:
+            self.fail_launches -= 1
+            raise RuntimeError("quota exceeded")
+        out = []
+        for _ in range(count):
+            self._n += 1
+
+            class N:
+                pass
+
+            n = N()
+            n.node_id = f"prov-{self._n}"
+            n.node_type = getattr(node_type, "name", "cpu")
+            n.slice_name = ""
+            self.nodes[n.node_id] = n
+            out.append(n)
+        return out
+
+    def terminate_node(self, node):
+        self.nodes.pop(node.node_id, None)
+        self.terminated.append(node.node_id)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes.values())
+
+
+def _types():
+    return {"cpu": NodeTypeConfig(name="cpu", resources={"CPU": 4.0},
+                                  min_workers=0, max_workers=10)}
+
+
+def _gcs_view(provider, alive=True):
+    return [{"node_id": f"gcs-{pid}", "alive": alive,
+             "labels": {"ray_tpu.io/provider-id": pid}}
+            for pid in provider.nodes]
+
+
+def test_full_lifecycle_to_running():
+    im = InstanceManager()
+    prov = FakeProvider()
+    im.set_targets({"cpu": 2})
+    assert len(im.by_state(QUEUED)) == 2
+    im.step(prov, _types())  # launch -> ALLOCATED (sync provider)
+    assert len(im.by_state(ALLOCATED)) == 2
+    im.step(prov, _types(), gcs_nodes=_gcs_view(prov))
+    assert len(im.by_state(RAY_RUNNING)) == 2
+    assert all(i.raylet_node_id for i in im.by_state(RAY_RUNNING))
+
+
+def test_allocation_failure_retries_with_backoff_then_gives_up():
+    im = InstanceManager(max_allocation_retries=2, retry_backoff_s=0.0)
+    prov = FakeProvider(fail_launches=99)
+    im.set_targets({"cpu": 1})
+    for _ in range(1 + 2 * 2 + 2):  # enough passes for 2 retries + give-up
+        im.step(prov, _types())
+    assert im.instances == {}  # gave up -> TERMINATED and forgotten
+    assert prov.nodes == {}
+
+
+def test_retry_succeeds_after_transient_failure():
+    im = InstanceManager(max_allocation_retries=3, retry_backoff_s=0.0)
+    prov = FakeProvider(fail_launches=1)
+    im.set_targets({"cpu": 1})
+    im.step(prov, _types())  # fails -> ALLOCATION_FAILED
+    im.step(prov, _types())  # requeued
+    im.step(prov, _types())  # relaunched ok
+    assert len(im.by_state(ALLOCATED)) == 1
+    inst = im.by_state(ALLOCATED)[0]
+    assert inst.retries == 1
+
+
+def test_stuck_allocated_instance_terminated():
+    im = InstanceManager(ray_start_timeout_s=0.0)
+    prov = FakeProvider()
+    im.set_targets({"cpu": 1})
+    im.step(prov, _types())
+    assert len(im.by_state(ALLOCATED)) == 1
+    # no gcs registration ever arrives; next pass times it out
+    im.step(prov, _types(), gcs_nodes=[])
+    im.step(prov, _types(), gcs_nodes=[])
+    assert prov.terminated, "stuck instance should be terminated"
+    assert im.instances == {}
+
+
+def test_scale_down_drains_running_instances():
+    im = InstanceManager()
+    prov = FakeProvider()
+    im.set_targets({"cpu": 2})
+    im.step(prov, _types())
+    im.step(prov, _types(), gcs_nodes=_gcs_view(prov))
+    assert len(im.by_state(RAY_RUNNING)) == 2
+    drained = []
+    im.set_targets({"cpu": 1})
+    assert len(im.by_state(RAY_STOPPING)) == 1
+    im.step(prov, _types(), gcs_nodes=_gcs_view(prov),
+            drain=lambda nid: drained.append(nid))
+    im.step(prov, _types(), gcs_nodes=_gcs_view(prov))
+    assert drained and len(prov.terminated) == 1
+    assert im.active_count("cpu") == 1
+
+
+def test_dead_node_detected_and_cleaned():
+    im = InstanceManager()
+    prov = FakeProvider()
+    im.set_targets({"cpu": 1})
+    im.step(prov, _types())
+    im.step(prov, _types(), gcs_nodes=_gcs_view(prov))
+    assert len(im.by_state(RAY_RUNNING)) == 1
+    im.step(prov, _types(), gcs_nodes=_gcs_view(prov, alive=False))
+    assert len(im.by_state(TERMINATING)) + len(prov.terminated) >= 1
+
+
+def test_persistence_roundtrip():
+    store = {}
+    im = InstanceManager(store=store)
+    prov = FakeProvider()
+    im.set_targets({"cpu": 2})
+    im.step(prov, _types())
+    assert len(store) == 2
+    # a restarted manager resumes the same instances (no double-launch)
+    im2 = InstanceManager(store=store)
+    assert im2.active_count("cpu") == 2
+    assert len(im2.by_state(ALLOCATED)) == 2
+    im2.step(prov, _types(), gcs_nodes=_gcs_view(prov))
+    assert len(im2.by_state(RAY_RUNNING)) == 2
+    assert len(prov.nodes) == 2  # never launched extras
+
+
+def test_invalid_transition_rejected():
+    im = InstanceManager()
+    inst = im.add("cpu")
+    with pytest.raises(InvalidTransition):
+        im.transition(inst, RAY_RUNNING)  # QUEUED cannot jump to RUNNING
