@@ -20,6 +20,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 logger = logging.getLogger("ray_tpu.autoscaler.v2")
 
+# the label raylets carry to map GCS nodes back to provider nodes — import
+# the real constant so the join cannot drift from what providers set
+from ray_tpu.autoscaler.autoscaler import PROVIDER_ID_LABEL
+
 # lifecycle states (reference: instance_manager/common.py InstanceUtil)
 QUEUED = "QUEUED"
 REQUESTED = "REQUESTED"
@@ -32,7 +36,7 @@ ALLOCATION_FAILED = "ALLOCATION_FAILED"
 
 _TRANSITIONS = {
     QUEUED: {REQUESTED, TERMINATED},
-    REQUESTED: {ALLOCATED, ALLOCATION_FAILED},
+    REQUESTED: {ALLOCATED, ALLOCATION_FAILED, TERMINATING},
     ALLOCATED: {RAY_RUNNING, TERMINATING},
     RAY_RUNNING: {RAY_STOPPING, TERMINATING},
     RAY_STOPPING: {TERMINATING},
@@ -59,6 +63,7 @@ class Instance:
     slice_name: str = ""
     created_at: float = field(default_factory=time.time)
     state_since: float = field(default_factory=time.time)
+    last_seen: float = 0.0  # last time the GCS reported the node alive
     retries: int = 0
     history: List[tuple] = field(default_factory=list)  # (ts, from, to, why)
 
@@ -66,7 +71,7 @@ class Instance:
         return {k: getattr(self, k) for k in (
             "instance_id", "node_type", "state", "provider_node_id",
             "raylet_node_id", "slice_name", "created_at", "state_since",
-            "retries")}
+            "last_seen", "retries")}
 
     @classmethod
     def restore(cls, d: dict) -> "Instance":
@@ -142,21 +147,18 @@ class InstanceManager:
             surplus = self.active_count(node_type) - want
             if surplus <= 0:
                 continue
-            # shed from the least-committed end first: queued before
-            # requested before running (running nodes drain gracefully)
-            for state in (QUEUED, ALLOCATION_FAILED):
+            # shed from the least-committed end first; every shed here
+            # is an ACTIVE instance, so the surplus accounting stays true
+            # (failed instances are not active and retire via step())
+            shed_plan = ((QUEUED, TERMINATED), (REQUESTED, TERMINATING),
+                         (ALLOCATED, TERMINATING), (RAY_RUNNING, RAY_STOPPING))
+            for state, to in shed_plan:
                 for inst in self.by_state(state):
                     if surplus <= 0:
                         break
                     if inst.node_type == node_type:
-                        self.transition(inst, TERMINATED, "target shrank")
+                        self.transition(inst, to, "target shrank")
                         surplus -= 1
-            for inst in self.by_state(RAY_RUNNING):
-                if surplus <= 0:
-                    break
-                if inst.node_type == node_type:
-                    self.transition(inst, RAY_STOPPING, "target shrank")
-                    surplus -= 1
 
     def step(self, provider, node_types: Dict[str, Any],
              gcs_nodes: Optional[List[dict]] = None,
@@ -166,7 +168,7 @@ class InstanceManager:
         provider_nodes = {n.node_id: n for n in provider.non_terminated_nodes()}
         gcs_by_provider: Dict[str, dict] = {}
         for n in gcs_nodes or []:
-            pid = n.get("labels", {}).get("ray_tpu.io/provider-id", "")
+            pid = n.get("labels", {}).get(PROVIDER_ID_LABEL, "")
             if pid:
                 gcs_by_provider[pid] = n
 
@@ -189,11 +191,28 @@ class InstanceManager:
                 self.transition(inst, ALLOCATED, "provider returned node")
             # async providers return later; found via provider view below
 
-        # REQUESTED -> ALLOCATED / ALLOCATION_FAILED (timeout)
+        # REQUESTED -> ALLOCATED / ALLOCATION_FAILED (timeout). Async
+        # providers return no node from create_nodes(): adopt an unclaimed
+        # provider node of the right type from the view, so a late
+        # provision is tracked instead of leaking while we re-launch.
+        claimed = {i.provider_node_id for i in self.instances.values()
+                   if i.provider_node_id}
         for inst in self.by_state(REQUESTED):
             if inst.provider_node_id and inst.provider_node_id in provider_nodes:
                 self.transition(inst, ALLOCATED, "provider view")
-            elif now - inst.state_since > self.request_timeout_s:
+                continue
+            if not inst.provider_node_id:
+                orphan = next(
+                    (n for n in provider_nodes.values()
+                     if n.node_id not in claimed
+                     and getattr(n, "node_type", "") == inst.node_type), None)
+                if orphan is not None:
+                    inst.provider_node_id = orphan.node_id
+                    inst.slice_name = getattr(orphan, "slice_name", "")
+                    claimed.add(orphan.node_id)
+                    self.transition(inst, ALLOCATED, "adopted provider node")
+                    continue
+            if now - inst.state_since > self.request_timeout_s:
                 self.transition(inst, ALLOCATION_FAILED, "request timed out")
 
         # ALLOCATION_FAILED -> QUEUED (retry) or TERMINATED (gave up)
@@ -212,15 +231,22 @@ class InstanceManager:
             g = gcs_by_provider.get(inst.provider_node_id)
             if g is not None and g.get("alive"):
                 inst.raylet_node_id = g.get("node_id", "")
+                inst.last_seen = now
                 self.transition(inst, RAY_RUNNING, "raylet registered")
             elif now - inst.state_since > self.ray_start_timeout_s:
                 self.transition(inst, TERMINATING, "raylet never registered")
 
-        # RAY_RUNNING whose node died under us -> TERMINATING
+        # RAY_RUNNING whose node died under us -> TERMINATING. A node
+        # that VANISHED from the GCS view (entry evicted/tombstoned) is
+        # dead too — after a grace window covering a missed poll.
         for inst in self.by_state(RAY_RUNNING):
             g = gcs_by_provider.get(inst.provider_node_id)
-            if g is not None and not g.get("alive", True):
+            if g is not None and g.get("alive", True):
+                inst.last_seen = now
+            elif g is not None:
                 self.transition(inst, TERMINATING, "node died")
+            elif gcs_nodes is not None and inst.last_seen                     and now - inst.last_seen > self.request_timeout_s:
+                self.transition(inst, TERMINATING, "node vanished from GCS")
 
         # RAY_STOPPING: drain, then terminate
         for inst in self.by_state(RAY_STOPPING):

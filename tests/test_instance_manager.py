@@ -55,8 +55,10 @@ def _types():
 
 
 def _gcs_view(provider, alive=True):
+    from ray_tpu.autoscaler.autoscaler import PROVIDER_ID_LABEL
+
     return [{"node_id": f"gcs-{pid}", "alive": alive,
-             "labels": {"ray_tpu.io/provider-id": pid}}
+             "labels": {PROVIDER_ID_LABEL: pid}}
             for pid in provider.nodes]
 
 
@@ -156,3 +158,55 @@ def test_invalid_transition_rejected():
     inst = im.add("cpu")
     with pytest.raises(InvalidTransition):
         im.transition(inst, RAY_RUNNING)  # QUEUED cannot jump to RUNNING
+
+
+def test_scale_down_sheds_allocated_before_running():
+    im = InstanceManager()
+    prov = FakeProvider()
+    im.set_targets({"cpu": 2})
+    im.step(prov, _types())  # both ALLOCATED
+    im.set_targets({"cpu": 1})
+    assert len(im.by_state(TERMINATING)) == 1  # ALLOCATED shed immediately
+    im.step(prov, _types())
+    assert im.active_count("cpu") == 1 and len(prov.terminated) == 1
+
+
+def test_async_provider_node_adopted_not_leaked():
+    """A provider that provisions asynchronously (create_nodes returns [])
+    must have its late node adopted by the REQUESTED instance instead of
+    leaking it and double-launching."""
+    im = InstanceManager(request_timeout_s=3600.0)
+    prov = FakeProvider()
+
+    real_create = prov.create_nodes
+
+    def async_create(node_type, count):
+        real_create(node_type, count)  # provisions, but reports nothing
+        return []
+
+    prov.create_nodes = async_create
+    im.set_targets({"cpu": 1})
+    im.step(prov, _types())  # REQUESTED, no provider_node_id yet
+    assert len(im.by_state(REQUESTED)) == 1
+    im.step(prov, _types())  # adopts the orphan from the provider view
+    assert len(im.by_state(ALLOCATED)) == 1
+    assert im.by_state(ALLOCATED)[0].provider_node_id
+    assert len(prov.nodes) == 1  # no double-launch
+
+
+def test_vanished_node_detected_after_grace():
+    im = InstanceManager(request_timeout_s=0.0)
+    prov = FakeProvider()
+    im.set_targets({"cpu": 1})
+    im.step(prov, _types())
+    im.step(prov, _types(), gcs_nodes=_gcs_view(prov))
+    assert len(im.by_state(RAY_RUNNING)) == 1
+    # the node's GCS entry disappears entirely (evicted/tombstoned)
+    import time as _t
+
+    _t.sleep(0.01)
+    im.step(prov, _types(), gcs_nodes=[])
+    # detected, drained through TERMINATING, and (same pass) the provider
+    # node was reclaimed
+    assert prov.terminated, "vanished node should be reclaimed"
+    assert not im.by_state(RAY_RUNNING)
