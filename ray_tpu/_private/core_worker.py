@@ -43,7 +43,7 @@ from ray_tpu._private.rpc import (
     RpcServer,
     RetryingRpcClient,
 )
-from ray_tpu._private.serialization import deserialize, serialize
+from ray_tpu._private.serialization import deserialize, loads_trusted, serialize
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
@@ -267,7 +267,7 @@ class _LeasePool:
                 core._complete_ok(record, res["results"],
                                   stream_count=res.get("stream_count"))
             else:
-                err: TaskError = pickle.loads(res["error"])
+                err: TaskError = loads_trusted(res["error"])
                 opts = record["spec"].options
                 from ray_tpu.exceptions import StrayInterrupt
 
@@ -405,8 +405,9 @@ class _LeasePool:
                     pg_info = (await self.core._gcs_call(
                         "GetPlacementGroup",
                         {"pg_id": req["pg"]}))["info"]
-                except (RpcError, asyncio.TimeoutError, OSError):
-                    pass
+                except (RpcError, asyncio.TimeoutError, OSError) as e:
+                    logger.debug("GetPlacementGroup(%s) failed; treating "
+                                 "PG as gone: %s", req["pg"], e)
                 if pg_info is None or pg_info.get("state") == "REMOVED":
                     raise RuntimeError(
                         "placement group was removed; queued tasks against "
@@ -703,8 +704,9 @@ class CoreWorker:
                     "AddBorrowers", wire.dumps(
                         {"oids": oids, "address": self.address}),
                     timeout=10.0, retries=1)
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass  # next sweep retries; the owner may simply be gone
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                # next sweep retries; the owner may simply be gone
+                logger.debug("AddBorrowers to %s failed: %s", owner, e)
 
         # concurrent: one slow/dead owner must not delay re-asserts to the
         # reachable ones while their death-timeout clocks run
@@ -866,7 +868,7 @@ class CoreWorker:
             reply = await self._gcs_call("KVGet", {"ns": "fn", "key": key})
             if reply["value"] is None:
                 raise RuntimeError(f"function {key} not found in GCS")
-            fn = cloudpickle.loads(reply["value"])
+            fn = loads_trusted(reply["value"])
             self._fn_cache[key] = fn
         return fn
 
@@ -1032,7 +1034,7 @@ class CoreWorker:
             if status == "in_store":
                 return None, True
             if status == "error":
-                raise pickle.loads(reply["error"])
+                raise loads_trusted(reply["error"])
             # pending: loop
 
     async def _maybe_pull_device(self, value, deadline):
@@ -1193,8 +1195,9 @@ class CoreWorker:
             if freed_in_store:
                 try:
                     await self._gcs_call("ObjectFree", {"oids": freed_in_store})
-                except (RpcError, asyncio.TimeoutError, OSError):
-                    pass
+                except (RpcError, asyncio.TimeoutError, OSError) as e:
+                    logger.debug("ObjectFree(%d oids) to GCS failed: %s",
+                                 len(freed_in_store), e)
             await self.raylet.call("StoreDelete", wire.dumps({"oids": oids}))
 
         self._run(_free())
@@ -1210,7 +1213,7 @@ class CoreWorker:
             return
         try:
             self.loop.call_soon_threadsafe(self._schedule_free, oid)
-        except RuntimeError:
+        except RuntimeError:  # raylint: disable=EXC001 loop already closed during shutdown; nothing left to free
             pass
 
     def _schedule_free(self, oid: bytes):
@@ -1248,8 +1251,9 @@ class CoreWorker:
         if in_store:
             try:
                 await self._gcs_call("ObjectFree", {"oids": [oid_bytes]})
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("ObjectFree(%s) to GCS failed: %s",
+                             oid_bytes.hex()[:8], e)
         if rc.lineage_count(oid_bytes) == 0:
             rc.drop(oid_bytes)
         self._maybe_drop_record(oid.task_id())
@@ -1267,8 +1271,9 @@ class CoreWorker:
                 await self._worker_client(value.address).call(
                     "FreeDeviceObject", wire.dumps({"oid": value.oid}),
                     timeout=10.0, retries=1)
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("FreeDeviceObject to %s failed: %s",
+                             value.address, e)
 
     def _on_borrow_first(self, oid: bytes, owner: str):
         """First local handle to a foreign-owned object: register as a
@@ -1283,7 +1288,7 @@ class CoreWorker:
 
         try:
             self.loop.call_soon_threadsafe(_later)
-        except RuntimeError:
+        except RuntimeError:  # raylint: disable=EXC001 loop already closed during shutdown; borrow is moot
             pass
 
     async def _register_borrow(self, oid: bytes, owner: str):
@@ -1452,8 +1457,9 @@ class CoreWorker:
         try:
             await self._worker_client(owner).call("AddBorrower", wire.dumps(
                 {"oid": oid, "address": borrower}), timeout=10.0, retries=1)
-        except (RpcError, asyncio.TimeoutError, OSError):
-            pass
+        except (RpcError, asyncio.TimeoutError, OSError) as e:
+            logger.debug("AddBorrower(%s) forward to owner %s failed: %s",
+                         oid.hex()[:8], owner, e)
 
     async def _recover_object(self, oid: ObjectID) -> bool:
         """Lineage reconstruction: re-execute the producing task (reference:
@@ -1707,7 +1713,9 @@ class CoreWorker:
                 try:
                     reply = await self._gcs_call(
                         "ObjectLocGet", {"oid": key}, timeout=5.0)
-                except Exception:
+                except Exception as e:
+                    logger.debug("ObjectLocGet(%s) failed; skipping this "
+                                 "pull round: %s", key.hex()[:8], e)
                     continue
                 if len(self._loc_cache) > 4096:
                     self._loc_cache.clear()
@@ -1786,8 +1794,9 @@ class CoreWorker:
             await self._raylet_client(lease["raylet_address"]).call(
                 "ReturnWorkerLease", wire.dumps({"lease_id": lease["lease_id"]}),
                 timeout=5.0, retries=1)
-        except (RpcError, asyncio.TimeoutError, OSError):
-            pass
+        except (RpcError, asyncio.TimeoutError, OSError) as e:
+            logger.debug("ReturnWorkerLease to %s failed: %s",
+                         lease["raylet_address"], e)
 
     # ------------------------------------------------------------------
     # actors (owner side)
@@ -1977,7 +1986,7 @@ class CoreWorker:
                 self._complete_ok(record, reply["results"],
                                   stream_count=reply.get("stream_count"))
             else:
-                self._complete_error(record, pickle.loads(reply["error"]))
+                self._complete_error(record, loads_trusted(reply["error"]))
             return
 
     def stream_next(self, task_id: TaskID, index: int,
@@ -2072,8 +2081,9 @@ class CoreWorker:
             for oid_b in st.get("pinned", ()):
                 try:
                     self.ref_counter.unpin(oid_b)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("stream unpin(%s) failed: %s",
+                                 oid_b.hex()[:8], e)
             st["pinned"] = set()
 
         if threading.current_thread() is self._loop_thread:
@@ -2150,8 +2160,9 @@ class CoreWorker:
                         "CancelTask", wire.dumps(
                             {"task_id": rec["spec"].task_id.binary(),
                              "force": False}), timeout=10.0, retries=1)
-                except (RpcError, asyncio.TimeoutError, OSError):
-                    pass  # actor death completes the call by itself
+                except (RpcError, asyncio.TimeoutError, OSError) as e:
+                    # actor death completes the call by itself
+                    logger.debug("CancelTask to %s failed: %s", addr, e)
             return
         if rec.get("_completed"):
             return  # finished: never signal (or force-kill!) its worker
@@ -2161,7 +2172,7 @@ class CoreWorker:
             if rec in pool.pending:
                 try:
                     pool.pending.remove(rec)
-                except ValueError:
+                except ValueError:  # raylint: disable=EXC001 a concurrent grant already dequeued it; cancellation continues via the push path
                     break
                 self._complete_error(rec, TaskCancelledError())
                 return
@@ -2172,8 +2183,10 @@ class CoreWorker:
                     "CancelTask", wire.dumps(
                         {"task_id": rec["spec"].task_id.binary(),
                          "force": force}), timeout=10.0, retries=1)
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass  # worker already gone: the push failure completes it
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                # worker already gone: the push failure completes it
+                logger.debug("CancelTask(force=%s) to %s failed: %s",
+                             force, addr, e)
         # else: awaiting dependency resolution — the resolver checks the
         # flag before the record can become push-eligible
 
@@ -3034,8 +3047,8 @@ class CoreWorker:
 
             if tracing.enabled():
                 tracing.flush()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("tracing flush at shutdown failed: %s", e)
 
         async def _close():
             if self.server:
@@ -3049,8 +3062,8 @@ class CoreWorker:
 
         try:
             self._run(_close(), timeout=10.0)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("rpc client close at shutdown failed: %s", e)
         if self._owned_loop:
             self.loop.call_soon_threadsafe(self.loop.stop)
             if self._loop_thread:

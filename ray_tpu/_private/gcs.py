@@ -576,8 +576,9 @@ class GcsServer:
             try:
                 await client.call("StoreDeleteStale", wire.dumps(
                     {"oid": oid, "attempt": attempt}), timeout=10.0, retries=1)
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("StoreDeleteStale(%s) to %s failed: %s",
+                             oid.hex()[:8], node_id.hex()[:8], e)
 
     async def _rpc_ObjectLocRemove(self, req, conn):
         for oid in req["oids"]:
@@ -626,8 +627,9 @@ class GcsServer:
             try:
                 await client.call("StoreDelete", wire.dumps({"oids": oids}),
                                   timeout=10.0, retries=1)
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("StoreDelete(%d oids) to %s failed: %s",
+                             len(oids), node_id.hex()[:8], e)
         return {"status": "ok"}
 
     async def _rpc_ObjectLocGet(self, req, conn):
@@ -844,8 +846,9 @@ class GcsServer:
                 logger.info("actor %s adopted on %s after GCS restart",
                             record.actor_id.hex()[:8], addr)
                 return
-        except (RpcError, asyncio.TimeoutError, OSError):
-            pass
+        except (RpcError, asyncio.TimeoutError, OSError) as e:
+            logger.debug("actor %s adoption probe to %s failed: %s",
+                         record.actor_id.hex()[:8], addr, e)
         # not there: give the lease back (if the raylet is still up), then
         # schedule from scratch
         if record.lease_id and record.node_id in self.node_clients:
@@ -853,8 +856,9 @@ class GcsServer:
                 await self.node_clients[record.node_id].call(
                     "ReturnWorkerLease", wire.dumps({"lease_id": record.lease_id}),
                     timeout=5.0, retries=1)
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("ReturnWorkerLease for actor %s failed: %s",
+                             record.actor_id.hex()[:8], e)
         record.address = ""
         record.node_id = None
         record.lease_id = ""
@@ -879,8 +883,9 @@ class GcsServer:
                         "Ping", b"", timeout=5.0, retries=1,
                         connect_timeout=3.0, presend_retries=1)
                     continue  # driver alive but quiet; keep polling
-                except (RpcError, asyncio.TimeoutError, OSError):
-                    pass
+                except (RpcError, asyncio.TimeoutError, OSError) as e:
+                    logger.debug("driver ping %s failed (job cleanup "
+                                 "candidate): %s", addr, e)
             logger.warning("job %s driver gone after GCS restart; finishing it",
                            job_id.hex())
             await self._finish_job(job_id)
@@ -970,8 +975,9 @@ class GcsServer:
                     "KillWorker", wire.dumps({"worker_address": address}),
                     timeout=10.0, retries=0, connect_timeout=2.0,
                     presend_retries=0)
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("KillWorker %s on %s failed (raylet likely "
+                             "dead): %s", address, record.node_id.hex()[:8], e)
         if no_restart:
             record.state = "DEAD"
             record.death_cause = reason
@@ -1060,8 +1066,9 @@ class GcsServer:
                 await self.node_clients[node_id].call("ReleasePGBundles", wire.dumps(
                     {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0,
                     retries=1, connect_timeout=2.0, presend_retries=0)
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("ReleasePGBundles pg=%s to %s failed: %s",
+                             pg.spec.pg_id.hex()[:8], node_id.hex()[:8], e)
         pg.ready_event.set()
 
     def _plan_pg(self, pg: PGRecord) -> Optional[List[NodeID]]:
@@ -1171,16 +1178,18 @@ class GcsServer:
                     try:
                         await self.node_clients[nid].call("ReleasePGBundles", wire.dumps(
                             {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0, retries=1)
-                    except (RpcError, asyncio.TimeoutError, OSError):
-                        pass
+                    except (RpcError, asyncio.TimeoutError, OSError) as e:
+                        logger.debug("ReleasePGBundles pg=%s to %s failed: %s",
+                                     pg.spec.pg_id.hex()[:8], nid.hex()[:8], e)
                 await asyncio.sleep(0.3)
                 continue
             for nid in per_node:
                 try:
                     await self.node_clients[nid].call("CommitPGBundles", wire.dumps(
                         {"pg_id": pg.spec.pg_id.binary()}), timeout=10.0)
-                except (RpcError, asyncio.TimeoutError, OSError):
-                    pass
+                except (RpcError, asyncio.TimeoutError, OSError) as e:
+                    logger.debug("CommitPGBundles pg=%s to %s failed: %s",
+                                 pg.spec.pg_id.hex()[:8], nid.hex()[:8], e)
             pg.bundle_nodes = list(plan)
             pg.state = "CREATED"
             self._persist_pg(pg)

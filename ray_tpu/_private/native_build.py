@@ -50,7 +50,7 @@ def build_and_load(src: str, lib_path: str,
                 # corrupt or wrong-arch artifact: rebuild once
                 try:
                     os.unlink(lib_path)
-                except OSError:
+                except OSError:  # raylint: disable=EXC001 rebuild below handles the stale artifact either way
                     pass
                 if _build():
                     try:

@@ -7,6 +7,7 @@ process launchers (``start_gcs_server`` :1434, ``start_raylet`` :1518).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import subprocess
 import sys
@@ -15,6 +16,8 @@ import uuid
 from typing import Dict, List, Optional
 
 from ray_tpu._private.config import RAY_CONFIG
+
+logger = logging.getLogger("ray_tpu.node")
 
 
 def _wait_for_file(path: str, timeout: float = 30.0,
@@ -44,7 +47,7 @@ def new_session_dir() -> str:
         if os.path.islink(latest):
             os.unlink(latest)
         os.symlink(session, latest)
-    except OSError:
+    except OSError:  # raylint: disable=EXC001 session_latest symlink is a convenience; racing starters may lose
         pass
     return session
 
@@ -161,8 +164,8 @@ class NodeSupervisor:
         for proc in reversed(self.processes):
             try:
                 proc.terminate()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("terminate of pid %s failed: %s", proc.pid, e)
         deadline = time.monotonic() + 3.0
         for proc in self.processes:
             try:
@@ -170,6 +173,6 @@ class NodeSupervisor:
             except Exception:
                 try:
                     proc.kill()
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("kill of pid %s failed: %s", proc.pid, e)
         self.processes.clear()

@@ -76,8 +76,8 @@ class ShmSegment:
     def close(self):
         try:
             self.buf.close()
-        except (BufferError, ValueError):
-            pass  # exported memoryviews still alive; mapping freed at process exit
+        except (BufferError, ValueError):  # raylint: disable=EXC001 exported memoryviews still alive; mapping freed at process exit
+            pass
 
     def unlink(self):
         try:
@@ -97,7 +97,7 @@ def _shm_mapped_by_live_process(name: str) -> bool:
                 for line in f:
                     if needle in line:
                         return True
-        except OSError:
+        except OSError:  # raylint: disable=EXC001 /proc scan: pids exit mid-walk, other-uid maps unreadable
             continue
     return False
 
@@ -118,7 +118,7 @@ def sweep_stale_shm(prefix: str = "rtpu_", min_age_s: float = 10.0) -> int:
                     idx = line.find("/dev/shm/" + prefix)
                     if idx >= 0:
                         live.add(line[idx + 9:].split()[0])
-        except OSError:
+        except OSError:  # raylint: disable=EXC001 /proc scan: pids exit mid-walk, other-uid maps unreadable
             continue
     removed = 0
     now = time.time()
@@ -133,7 +133,7 @@ def sweep_stale_shm(prefix: str = "rtpu_", min_age_s: float = 10.0) -> int:
                 continue
             os.unlink(path)
             removed += 1
-        except OSError:
+        except OSError:  # raylint: disable=EXC001 concurrent GC: another raylet may unlink the segment first
             pass
     return removed
 
@@ -459,7 +459,7 @@ class ObjectStoreServer:
             if lst is not None:
                 try:
                     lst.remove(fut)
-                except ValueError:
+                except ValueError:  # raylint: disable=EXC001 waiter already removed by a concurrent seal
                     pass
                 if not lst:
                     self.waiters.pop(oid, None)

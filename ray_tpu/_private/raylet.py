@@ -177,8 +177,9 @@ class Raylet:
         for w in list(self.workers.values()):
             try:
                 w.proc.kill()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("kill of worker pid %s at stop failed: %s",
+                             w.pid, e)
         self.store.shutdown()
         await self.server.stop()
 
@@ -291,8 +292,9 @@ class Raylet:
                         del self.oom_kills[oldest]
                 try:
                     w.proc.kill()
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("OOM kill of pid %s failed (already "
+                                 "exited?): %s", w.pid, e)
             except Exception:
                 logger.exception("memory monitor iteration failed")
 
@@ -320,8 +322,9 @@ class Raylet:
                         total_resources=dict(self.total_resources),
                         labels=dict(self.labels), is_head=self.is_head)
                     await self.gcs.call("RegisterNode", wire.dumps({"info": info}))
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("heartbeat/re-register to GCS failed "
+                             "(will retry): %s", e)
             await asyncio.sleep(period)
 
     # ------------------------------------------------------------------
@@ -442,8 +445,9 @@ class Raylet:
                     "channel": "logs",
                     "message": {"node": node, "lines": lines[:200]},
                 }), timeout=5.0, retries=0)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("log publish to GCS failed (%d lines "
+                             "dropped): %s", len(lines), e)
 
     async def _prewarm_store(self):
         """Pre-touch arena pages in the background so early large puts
@@ -467,7 +471,9 @@ class Raylet:
                         w.registered, RAY_CONFIG.worker_start_timeout_s)
                 w.job_hex = None
                 self.idle_workers.append(w)
-            except Exception:
+            except Exception as e:
+                logger.debug("prestart worker spawn failed; stopping "
+                             "prestart: %s", e)
                 return
 
     async def _monitor_workers_loop(self):
@@ -498,8 +504,9 @@ class Raylet:
                             "node_id": self.node_id.hex(),
                             "reason": reason,
                         }), retries=2)
-                    except (RpcError, asyncio.TimeoutError, OSError):
-                        pass
+                    except (RpcError, asyncio.TimeoutError, OSError) as e:
+                        logger.debug("WorkerDied notify for %s failed: %s",
+                                     w.address, e)
 
     # ------------------------------------------------------------------
     # leases (reference: node_manager.cc:1820 HandleRequestWorkerLease)
@@ -670,8 +677,9 @@ class Raylet:
             return {"status": "not_found"}
         try:
             w.proc.kill()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("KillWorker pid %s failed (already exited?): %s",
+                         w.pid, e)
         return {"status": "ok"}
 
     async def _rpc_GetNodeStats(self, req, conn):
@@ -822,8 +830,9 @@ class Raylet:
         try:
             await self._owner_client(owner).call(
                 method, wire.dumps(msg), timeout=10.0, retries=1)
-        except (RpcError, asyncio.TimeoutError, OSError):
-            pass  # best-effort: the GCS directory still has it
+        except (RpcError, asyncio.TimeoutError, OSError) as e:
+            # best-effort: the GCS directory still has it
+            logger.debug("%s notify to owner %s failed: %s", method, owner, e)
 
     def _owner_client(self, addr: str) -> RetryingRpcClient:
         from collections import OrderedDict
@@ -882,8 +891,9 @@ class Raylet:
         try:
             await self.gcs.call("ObjectLocRemove", wire.dumps(
                 {"oids": req["oids"], "node_id": self.node_id}), retries=1)
-        except (RpcError, asyncio.TimeoutError, OSError):
-            pass
+        except (RpcError, asyncio.TimeoutError, OSError) as e:
+            logger.debug("ObjectLocRemove(%d oids) to GCS failed: %s",
+                         len(req["oids"]), e)
         for o, owner in owners.items():
             if owner:  # keep the owner-resident view from going stale
                 asyncio.ensure_future(self._notify_owner(

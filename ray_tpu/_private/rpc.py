@@ -188,13 +188,14 @@ class RpcServer:
             self._server.close()
             try:
                 await self._server.wait_closed()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("server wait_closed failed: %s", e)
         for conn in list(self.connections.values()):
             try:
                 conn.writer.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("closing connection to %s failed: %s",
+                             conn.peer, e)
 
     async def _on_client(self, reader, writer):
         conn = ServerConnection(reader, writer)
@@ -208,8 +209,8 @@ class RpcServer:
                     asyncio.ensure_future(self._dispatch(conn, msg_id, method, payload))
         except RpcVersionError as e:
             logger.warning("dropping %s: %s", conn.peer, e)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            pass
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            logger.debug("connection from %s closed: %s", conn.peer, e)
         finally:
             conn.closed.set()
             self.connections.pop(conn.conn_id, None)
@@ -220,8 +221,8 @@ class RpcServer:
                     logger.exception("on_disconnect handler failed")
             try:
                 writer.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("writer close for %s failed: %s", conn.peer, e)
 
     async def _dispatch(self, conn, msg_id, method, payload):
         try:
@@ -240,8 +241,9 @@ class RpcServer:
                 err = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
                 try:
                     await conn.reply(msg_id, _REPLY_ERR, err.encode())
-                except Exception:
-                    pass
+                except Exception as e2:
+                    logger.debug("error reply to %s undeliverable: %s",
+                                 conn.peer, e2)
             else:
                 logger.exception("error in one-way handler %s", method)
 
@@ -361,8 +363,8 @@ class RpcClient:
         if self._writer:
             try:
                 self._writer.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("client writer close failed: %s", e)
 
 
 class RetryingRpcClient:

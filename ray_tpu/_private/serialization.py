@@ -53,6 +53,24 @@ def deserialize(inband: bytes, buffers: List[Any]) -> Any:
     return pickle.loads(inband, buffers=[pickle.PickleBuffer(b) for b in buffers])
 
 
+def loads_trusted(blob: bytes) -> Any:
+    """Unpickle a blob whose PRODUCER is trusted: client-proxy payloads, or
+    function/params blobs authored by the deploying driver.
+
+    Unpickling EXECUTES code from the blob, so this module is the single
+    audited chokepoint for it (enforced by raylint rule SER001). Calling this
+    is an explicit declaration that the bytes come from inside the cluster
+    trust boundary — e.g. the client-proxy port, which therefore must never
+    be exposed to untrusted networks (it has no authentication of its own).
+    Anything that must be safe against arbitrary senders goes through the
+    typed schema in ``wire.py`` instead, which never unpickles. If you are
+    about to call ``pickle.loads``/``cloudpickle.loads`` anywhere else, call
+    this instead — or better, ask whether the payload can be a wire-typed
+    message.
+    """
+    return cloudpickle.loads(blob)
+
+
 def dumps_oob(value: Any) -> bytes:
     """Single-blob serialization: [u32 nbuf][u64 len, bytes]* [inband]."""
     inband, buffers = serialize(value)

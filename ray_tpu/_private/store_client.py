@@ -121,8 +121,8 @@ class FileStoreClient(StoreClient):
                     "as %s", snap, corrupt, exc_info=True)
                 try:
                     os.replace(snap, corrupt)
-                except OSError:
-                    pass
+                except OSError as e:
+                    logger.debug("quarantine rename of %s failed: %s", snap, e)
                 self._tables = {}
         for op, table, key, value in self._read_journal():
             self._apply(op, table, key, value)
@@ -145,8 +145,9 @@ class FileStoreClient(StoreClient):
                         "is NOT loaded.", path, self.MAGIC, incompat)
                     try:
                         os.replace(path, incompat)
-                    except OSError:
-                        pass
+                    except OSError as e:
+                        logger.debug("quarantine rename of %s failed: %s",
+                                     path, e)
                 return
             good = f.tell()
             while True:
@@ -159,7 +160,9 @@ class FileStoreClient(StoreClient):
                     break
                 try:
                     yield wire.loads(body)
-                except Exception:
+                except Exception as e:
+                    logger.debug("journal replay stopped at torn/corrupt "
+                                 "record (offset %d): %s", good, e)
                     break
                 good = f.tell()
         size = os.path.getsize(path)
@@ -234,8 +237,8 @@ class FileStoreClient(StoreClient):
                 self._journal.flush()
                 os.fsync(self._journal.fileno())
                 self._journal.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("journal close failed: %s", e)
 
 
 def make_store(persist_dir: str = "") -> StoreClient:

@@ -16,7 +16,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ray_tpu._private.rpc import RetryingRpcClient, RpcError
+from ray_tpu._private.rpc import RetryingRpcClient
 from ray_tpu.autoscaler.config import ClusterConfig
 from ray_tpu.autoscaler.node_provider import NodeProvider, ProviderNode
 from ray_tpu.autoscaler.resource_demand_scheduler import (
@@ -115,8 +115,9 @@ class Autoscaler:
                         v["_gcs_node_id"][:8], v["node_type"])
             try:
                 self._gcs("DrainNode", {"node_id": _node_id_from_hex(v["_gcs_node_id"])})
-            except (RpcError, OSError, Exception):
-                pass
+            except Exception as e:
+                logger.debug("DrainNode %s failed (retried next tick): %s",
+                             v["_gcs_node_id"][:8], e)
             self.provider.terminate_node(v["_provider_node"])
 
         self.last_status = {

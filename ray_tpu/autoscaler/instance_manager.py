@@ -253,8 +253,9 @@ class InstanceManager:
             if drain is not None and inst.raylet_node_id:
                 try:
                     drain(inst.raylet_node_id)
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("drain of %s failed (instance still "
+                                 "terminates): %s", inst.instance_id, e)
             self.transition(inst, TERMINATING, "drained")
 
         # TERMINATING -> provider terminate -> TERMINATED

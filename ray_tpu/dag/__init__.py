@@ -526,8 +526,8 @@ class CompiledDAG:
             for c in list(pending_out):
                 try:
                     v = self._out_chans[c].read(timeout=1.0)
-                except Exception:
-                    continue  # nothing yet, or the writer already died
+                except Exception:  # raylint: disable=EXC001 drain poll: timeout and writer-death both just mean retry
+                    continue
                 progressed = True
                 if isinstance(v, _Stop):
                     pending_out.discard(c)
@@ -538,7 +538,7 @@ class CompiledDAG:
         for ch in self._channels:
             try:
                 ch.close(unlink=True)
-            except Exception:
+            except Exception:  # raylint: disable=EXC001 teardown: segment may already be unlinked by a peer
                 pass
         if kill_actors:
             for node in self._order:
@@ -547,6 +547,6 @@ class CompiledDAG:
                         and node._target._actor_handle is not None:
                     try:
                         ray_tpu.kill(node._target._actor_handle)
-                    except Exception:
+                    except Exception:  # raylint: disable=EXC001 teardown: actor may already be dead
                         pass
                     node._target._actor_handle = None

@@ -78,7 +78,7 @@ def get_transfer(instance, shift: int = 1) -> IciTransfer:
         cache = {}
         try:
             instance.__rtpu_ici_transfers__ = cache
-        except AttributeError:
+        except AttributeError:  # raylint: disable=EXC001 slots-only actor class; fall back to uncached transfers
             pass
     t = cache.get(shift)
     if t is None:

@@ -30,9 +30,11 @@ class LLMServer:
 
         params = None
         if params_blob is not None:
-            import cloudpickle
+            # driver-authored params blob: deserialize only through the
+            # audited serialization boundary (raylint SER001)
+            from ray_tpu._private.serialization import loads_trusted
 
-            params = cloudpickle.loads(params_blob)
+            params = loads_trusted(params_blob)
         self.config = config
         self.engine = JaxLLMEngine(config, params=params)
         self._futures: Dict[str, asyncio.Future] = {}

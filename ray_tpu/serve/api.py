@@ -863,6 +863,7 @@ class _HttpProxy:
                 import threading as _th
 
                 q: _qmod.Queue = _qmod.Queue(maxsize=8)
+                # raylint: disable=ASY002 cross-thread stop flag: loop side only set()/is_set(), never wait()
                 stop = _th.Event()
                 _END = object()
 

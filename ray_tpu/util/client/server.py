@@ -21,6 +21,7 @@ import cloudpickle
 
 import ray_tpu
 from ray_tpu._private.rpc import RpcServer
+from ray_tpu._private.serialization import loads_trusted
 
 
 class _ClientSession:
@@ -102,7 +103,10 @@ class ClientProxyServer:
                 pass
 
     async def _handle(self, method: str, payload: bytes, conn) -> bytes:
-        req = pickle.loads(payload) if payload else {}
+        # trusted ingress: payloads execute code on load, so this port must
+        # stay inside the cluster trust boundary (no auth of its own); every
+        # unpickle goes through the audited serialization chokepoint (SER001)
+        req = loads_trusted(payload) if payload else {}
         sess = self._session(conn, req)
         loop = asyncio.get_event_loop()
 
@@ -112,7 +116,7 @@ class ClientProxyServer:
             return loop.run_in_executor(self._pool, lambda: fn(*args, **kw))
 
         if method == "Put":
-            ref = await blocking(ray_tpu.put, cloudpickle.loads(req["blob"]))
+            ref = await blocking(ray_tpu.put, loads_trusted(req["blob"]))
             sess.refs[ref.binary()] = ref
             return pickle.dumps({"ref": ref.binary()})
 
@@ -138,7 +142,7 @@ class ClientProxyServer:
         if method == "SubmitTask":
             fn = sess.functions.get(req["fn_hash"])
             if fn is None:
-                fn = ray_tpu.remote(cloudpickle.loads(req["fn_blob"]))
+                fn = ray_tpu.remote(loads_trusted(req["fn_blob"]))
                 sess.functions[req["fn_hash"]] = fn
             args, kwargs = self._rebuild_args(sess, req["args_blob"])
             opts = req.get("options") or {}
@@ -152,7 +156,7 @@ class ClientProxyServer:
         if method == "CreateActor":
             cls = sess.classes.get(req["cls_hash"])
             if cls is None:
-                cls = ray_tpu.remote(cloudpickle.loads(req["cls_blob"]))
+                cls = ray_tpu.remote(loads_trusted(req["cls_blob"]))
                 sess.classes[req["cls_hash"]] = cls
             args, kwargs = self._rebuild_args(sess, req["args_blob"])
             opts = req.get("options") or {}
@@ -223,7 +227,7 @@ class ClientProxyServer:
 
     def _rebuild_args(self, sess, blob):
         """Client-side refs arrive as markers; swap in the proxy's refs."""
-        args, kwargs = cloudpickle.loads(blob)
+        args, kwargs = loads_trusted(blob)
 
         def fix(v):
             if isinstance(v, _RefMarker):

@@ -1,0 +1,114 @@
+"""Tier-1 gate: raylint must pass over ray_tpu/ with the checked-in baseline.
+
+This is the enforcement point for the runtime's source-level invariants
+(tools/raylint/README.md): introducing a blocking call in an async body, an
+await under a threading lock, a stray unpickle, a silently swallowed
+control-plane exception, or an unregistered wire struct fails tier-1 — no
+extra CI infrastructure needed.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+from tools.raylint import core  # noqa: E402
+
+BASELINE = REPO_ROOT / "tools" / "raylint" / "baseline.json"
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    baseline = core.load_baseline(BASELINE)
+    return core.check_paths([REPO_ROOT / "ray_tpu"], REPO_ROOT,
+                            baseline=baseline)
+
+
+def test_repo_is_clean_with_baseline(repo_report):
+    report = repo_report
+    # a vacuously-green scan (0 files) must fail, not pass
+    assert report.files_checked > 50, report.files_checked
+    msg = "\n".join(f.render() for f in report.findings)
+    assert report.ok, (
+        f"new raylint finding(s) — fix them, add a "
+        f"`# raylint: disable=<RULE> <reason>` with justification, or (for "
+        f"reviewed-benign cases) regenerate the baseline:\n{msg}")
+
+
+def test_baseline_has_no_stale_entries(repo_report):
+    """Every baseline entry must still match a real finding: when a fix
+    removes one, the baseline shrinks with it (keeps the file honest)."""
+    report = repo_report
+    stale = "\n".join(f"{r} {p}: {s!r}" for r, p, s in report.unused_baseline)
+    assert not report.unused_baseline, (
+        f"stale baseline entries — rerun "
+        f"`python -m tools.raylint --write-baseline`:\n{stale}")
+
+
+def test_baseline_is_sorted_and_deterministic():
+    doc = json.loads(BASELINE.read_text())
+    keys = [(e["rule"], e["path"], e["snippet"]) for e in doc["findings"]]
+    assert keys == sorted(keys), "baseline entries must be sorted"
+    assert len(keys) == len(set(keys)), (
+        "duplicate baseline keys (use the count field instead)")
+    assert all(e.get("count", 1) >= 1 for e in doc["findings"])
+
+
+def test_at_least_five_rules_active():
+    rules = core.all_rules()
+    assert len(rules) >= 5, f"expected >= 5 rules, have {sorted(rules)}"
+    for required in ("ASY001", "ASY002", "SER001", "EXC001", "WIRE001"):
+        assert required in rules
+
+
+def test_gate_catches_new_violations():
+    """A deliberately-bad control-plane snippet must trip every async/ser/exc
+    rule — proving the tier-1 gate actually fires on regressions."""
+    bad = textwrap.dedent("""
+        import asyncio
+        import pickle
+        import threading
+        import time
+
+        async def handler(self, req):
+            time.sleep(1)                     # ASY001
+            with self._lock:                  # ASY002
+                await asyncio.sleep(0)
+            state = pickle.loads(req)         # SER001
+            try:
+                return state
+            except Exception:                 # EXC001
+                pass
+    """)
+    project = core.Project(REPO_ROOT)
+    findings = project.check_source(bad, "ray_tpu/_private/fake_control.py")
+    hit = {f.rule for f in findings}
+    assert {"ASY001", "ASY002", "SER001", "EXC001"} <= hit, (
+        f"gate failed to flag a deliberately-bad snippet; got {sorted(hit)}: "
+        + "\n".join(f.render() for f in findings))
+
+
+def test_cli_end_to_end(tmp_path):
+    """`python -m tools.raylint` exits 0 on the repo and 1 on a bad tree."""
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.raylint", "ray_tpu"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    bad_dir = tmp_path / "_private"
+    bad_dir.mkdir()
+    (bad_dir / "bad.py").write_text(
+        "import time\nasync def f():\n    time.sleep(1)\n")
+    dirty = subprocess.run(
+        [sys.executable, "-m", "tools.raylint", str(bad_dir), "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+    payload = json.loads(dirty.stdout)
+    assert payload["findings"] and payload["findings"][0]["rule"] == "ASY001"

@@ -1,0 +1,453 @@
+"""Unit fixtures for each raylint rule: one positive and one negative case
+per rule, plus the suppression-comment and baseline mechanisms."""
+
+import sys
+import textwrap
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+from tools.raylint import core  # noqa: E402
+
+
+def lint(src, relpath="ray_tpu/_private/mod.py", root=REPO_ROOT, rules=None):
+    project = core.Project(root, rule_names=rules)
+    return project.check_source(textwrap.dedent(src), relpath)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# ASY001 — blocking call in async body
+# ---------------------------------------------------------------------------
+
+
+def test_asy001_positive():
+    findings = lint("""
+        import time
+        from time import sleep as zzz
+        import subprocess
+
+        async def f(self):
+            time.sleep(0.5)
+            zzz(1)
+            subprocess.check_output(["ls"])
+    """, rules=["ASY001"])
+    assert rules_of(findings) == ["ASY001"] * 3
+    # import aliasing is resolved back to the real callable
+    assert "time.sleep" in findings[1].message
+
+
+def test_asy001_blocking_cluster_wait():
+    findings = lint("""
+        import ray_tpu
+
+        async def f(refs):
+            return ray_tpu.get(refs)
+    """, rules=["ASY001"])
+    assert rules_of(findings) == ["ASY001"]
+
+
+def test_asy001_negative():
+    findings = lint("""
+        import asyncio
+        import time
+
+        def sync_fn():
+            time.sleep(1)          # sync context: fine
+
+        async def f(loop):
+            await asyncio.sleep(1)
+            # blocking work pushed off-loop is the sanctioned pattern
+            await loop.run_in_executor(None, lambda: time.sleep(1))
+
+        async def g(self):
+            def thunk():
+                time.sleep(2)      # nested sync def: runs in an executor
+            return thunk
+    """, rules=["ASY001"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# ASY002 — threading primitives in async context
+# ---------------------------------------------------------------------------
+
+
+def test_asy002_positive_await_under_lock():
+    findings = lint("""
+        import asyncio
+
+        async def f(self):
+            with self._lock:
+                await asyncio.sleep(0)
+    """, rules=["ASY002"])
+    assert rules_of(findings) == ["ASY002"]
+
+
+def test_asy002_positive_threading_primitive():
+    findings = lint("""
+        import threading
+
+        async def f():
+            ev = threading.Event()
+            return ev
+    """, rules=["ASY002"])
+    assert rules_of(findings) == ["ASY002"]
+
+
+def test_asy002_negative():
+    findings = lint("""
+        import asyncio
+        import threading
+
+        def sync_fn(self):
+            with self._lock:       # no loop on this thread
+                return 1
+
+        async def f(self):
+            with self._lock:       # no await inside: bounded hold
+                x = 1
+            async with self._alock:
+                await asyncio.sleep(0)
+
+        def make():
+            return threading.Lock()
+    """, rules=["ASY002"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SER001 — unpickle outside the serialization boundary
+# ---------------------------------------------------------------------------
+
+
+def test_ser001_positive():
+    src = """
+        import pickle
+        import cloudpickle
+
+        def decode(blob):
+            a = pickle.loads(blob)
+            return cloudpickle.loads(blob)
+    """
+    findings = lint(src, relpath="ray_tpu/util/foo.py", rules=["SER001"])
+    assert rules_of(findings) == ["SER001", "SER001"]
+
+
+def test_ser001_negative_allowlisted_boundary():
+    src = """
+        import pickle
+
+        def deserialize(blob):
+            return pickle.loads(blob)
+    """
+    findings = lint(src, relpath="ray_tpu/_private/serialization.py",
+                    rules=["SER001"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# EXC001 — swallowed exceptions on control-plane paths
+# ---------------------------------------------------------------------------
+
+
+def test_exc001_positive():
+    findings = lint("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+            try:
+                g()
+            except (OSError, ValueError):
+                ...
+    """, rules=["EXC001"])
+    assert rules_of(findings) == ["EXC001", "EXC001"]
+
+
+def test_exc001_break_and_bare_return_also_swallow():
+    findings = lint("""
+        def f(items):
+            for i in items:
+                try:
+                    g(i)
+                except Exception:
+                    break
+            try:
+                g(0)
+            except Exception:
+                return
+            try:
+                g(1)
+            except Exception:
+                return fallback()   # uses the failure: not a silent swallow
+    """, rules=["EXC001"])
+    assert rules_of(findings) == ["EXC001", "EXC001"]
+
+
+def test_exc001_negative_logged_or_narrow_or_offplane():
+    # a log call, a narrow control-flow catch, and a non-control-plane path
+    # are all fine
+    clean = """
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def f(d):
+            try:
+                g()
+            except Exception as e:
+                logger.debug("g failed: %s", e)
+            try:
+                del d["k"]
+            except KeyError:
+                pass
+            try:
+                h()
+            except asyncio.TimeoutError:
+                pass
+    """
+    assert lint(clean, rules=["EXC001"]) == []
+    swallowing = """
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """
+    assert lint(swallowing, relpath="ray_tpu/serve/mod.py",
+                rules=["EXC001"]) == []
+
+
+# ---------------------------------------------------------------------------
+# WIRE001 — unregistered wire structs
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def wire_root(tmp_path):
+    private = tmp_path / "ray_tpu" / "_private"
+    private.mkdir(parents=True)
+    (private / "wire.py").write_text(textwrap.dedent("""
+        def register_struct(cls, **kw):
+            return cls
+
+        def register_id(cls, **kw):
+            return cls
+
+        def _register_builtin_types():
+            from ray_tpu._private import common
+            for c in (common.Registered, common.AlsoRegistered):
+                register_struct(c)
+    """))
+    return tmp_path
+
+
+def test_wire001_positive(wire_root):
+    findings = lint("""
+        from dataclasses import dataclass
+
+        @dataclass
+        class Registered:
+            a: int = 0
+
+        @dataclass
+        class Orphan:
+            b: int = 0
+    """, relpath="ray_tpu/_private/common.py", root=wire_root,
+        rules=["WIRE001"])
+    assert rules_of(findings) == ["WIRE001"]
+    assert "Orphan" in findings[0].message
+
+
+def test_wire001_negative(wire_root):
+    findings = lint("""
+        from dataclasses import dataclass
+
+        @dataclass
+        class Registered:
+            a: int = 0
+
+        class NotWireData:
+            pass
+    """, relpath="ray_tpu/_private/common.py", root=wire_root,
+        rules=["WIRE001"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_same_line_and_above():
+    findings = lint("""
+        import time
+
+        async def f():
+            time.sleep(1)  # raylint: disable=ASY001 measured: shorter than a loop tick
+            # raylint: disable=ASY001 warmup path, loop not serving yet
+            time.sleep(2)
+            time.sleep(3)
+    """, rules=["ASY001"])
+    assert len(findings) == 1 and findings[0].line == 8
+
+
+def test_suppression_above_decorator_reaches_the_def_line(wire_root):
+    # WIRE001 findings anchor at the `class` line (py3.8+ AST excludes
+    # decorators from lineno); a directive above the decorator must still
+    # suppress them
+    findings = lint("""
+        from dataclasses import dataclass
+
+        # raylint: disable=WIRE001 process-local record
+        @dataclass
+        class Orphan:
+            b: int = 0
+    """, relpath="ray_tpu/_private/common.py", root=wire_root,
+        rules=["WIRE001"])
+    assert findings == []
+
+
+def test_suppression_is_rule_specific():
+    findings = lint("""
+        import time
+
+        async def f():
+            time.sleep(1)  # raylint: disable=EXC001 wrong rule id
+    """, rules=["ASY001"])
+    assert rules_of(findings) == ["ASY001"]
+
+
+def test_suppression_filewide_and_all():
+    src = """
+        # raylint: disable-file=ASY001
+        import time
+
+        async def f():
+            time.sleep(1)
+            x = 1  # raylint: disable=all
+    """
+    assert lint(src, rules=["ASY001"]) == []
+
+
+def test_directive_does_not_bind_across_blank_lines():
+    # a stale directive must not drift onto unrelated code below a gap
+    findings = lint("""
+        import time
+
+        # raylint: disable=ASY001 the line this covered was deleted
+
+        async def f():
+            time.sleep(1)
+    """, rules=["ASY001"])
+    assert rules_of(findings) == ["ASY001"]
+
+
+def test_rules_subset_does_not_report_other_rules_stale(tmp_path):
+    mod = tmp_path / "_private" / "mod.py"
+    mod.parent.mkdir()
+    mod.write_text("import pickle\n\ndef f(b):\n    return pickle.loads(b)\n")
+    full = core.check_paths([mod.parent], tmp_path)
+    baseline_path = tmp_path / "baseline.json"
+    baseline_path.write_text(core.dump_baseline(full.findings))  # SER001 entry
+    sub = core.check_paths([mod.parent], tmp_path,
+                           baseline=core.load_baseline(baseline_path),
+                           rule_names=["ASY001"])
+    assert sub.passed and not sub.unused_baseline
+
+
+def test_directive_inside_string_is_inert():
+    findings = lint('''
+        import time
+
+        DOC = "# raylint: disable-file=ASY001"
+
+        async def f():
+            time.sleep(1)
+    ''', rules=["ASY001"])
+    assert rules_of(findings) == ["ASY001"]
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanism
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_grandfathers_exact_findings(tmp_path):
+    mod = tmp_path / "_private" / "mod.py"
+    mod.parent.mkdir()
+    mod.write_text(textwrap.dedent("""
+        import time
+
+        async def old():
+            time.sleep(1)
+    """))
+    report = core.check_paths([mod.parent], tmp_path)
+    assert len(report.findings) == 1
+
+    baseline_doc = core.dump_baseline(report.findings)
+    baseline_path = tmp_path / "baseline.json"
+    baseline_path.write_text(baseline_doc)
+    baseline = core.load_baseline(baseline_path)
+
+    # same code: clean, nothing stale
+    report2 = core.check_paths([mod.parent], tmp_path, baseline=baseline)
+    assert report2.ok and not report2.unused_baseline
+    assert len(report2.baselined) == 1
+
+    # a NEW occurrence of the same pattern on a new line still fails
+    mod.write_text(mod.read_text() + "\nasync def new():\n    time.sleep(2)\n")
+    report3 = core.check_paths([mod.parent], tmp_path, baseline=baseline)
+    assert len(report3.findings) == 1
+    assert "time.sleep(2)" in report3.findings[0].snippet
+
+    # the baselined finding survives line drift (prepended code)
+    mod.write_text("X = 1\n" + textwrap.dedent("""
+        import time
+
+        async def old():
+            time.sleep(1)
+    """))
+    report4 = core.check_paths([mod.parent], tmp_path, baseline=baseline)
+    assert report4.ok, [f.render() for f in report4.findings]
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    mod = tmp_path / "_private" / "mod.py"
+    mod.parent.mkdir()
+    mod.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    report = core.check_paths([mod.parent], tmp_path)
+    baseline_path = tmp_path / "baseline.json"
+    baseline_path.write_text(core.dump_baseline(report.findings))
+
+    mod.write_text("import asyncio\nasync def f():\n    await asyncio.sleep(1)\n")
+    report2 = core.check_paths([mod.parent], tmp_path,
+                               baseline=core.load_baseline(baseline_path))
+    assert report2.ok
+    assert len(report2.unused_baseline) == 1
+
+
+def test_parse_error_is_a_finding():
+    findings = lint("def broken(:\n    pass\n")
+    assert rules_of(findings) == [core.PARSE_ERROR_RULE]
+    # NUL bytes raise ValueError (not SyntaxError) from ast.parse on
+    # py<=3.11; must still be a finding, not a crash
+    findings = lint("x = 1\x00\n")
+    assert rules_of(findings) == [core.PARSE_ERROR_RULE]
+
+
+def test_overlapping_paths_lint_each_file_once(tmp_path):
+    mod = tmp_path / "_private" / "mod.py"
+    mod.parent.mkdir()
+    mod.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    report = core.check_paths([tmp_path, mod.parent, mod], tmp_path)
+    assert report.files_checked == 1
+    assert len(report.findings) == 1

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# One-shot lint entry point: run raylint over the runtime with the checked-in
+# baseline (exactly what tests/test_raylint.py enforces in tier-1).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m tools.raylint "$@"

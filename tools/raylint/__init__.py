@@ -1,0 +1,22 @@
+"""raylint: AST-based invariant checker for the ray_tpu distributed runtime.
+
+See tools/raylint/README.md for rules, rationale, and suppression syntax.
+Programmatic entry points:
+
+    from tools.raylint import core
+    report = core.check_paths([Path("ray_tpu")], root=REPO_ROOT)
+"""
+
+from tools.raylint.core import (  # noqa: F401
+    Finding,
+    Project,
+    Report,
+    Rule,
+    all_rules,
+    check_paths,
+    dump_baseline,
+    load_baseline,
+    register_rule,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+import sys
+
+from tools.raylint.cli import main
+
+sys.exit(main())

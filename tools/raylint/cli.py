@@ -1,0 +1,130 @@
+"""raylint CLI.
+
+Usage::
+
+    python -m tools.raylint [paths ...] [options]
+
+With no paths, lints ``ray_tpu/`` under the repo root. Exit status: 0 when
+clean (every finding suppressed or baselined), 1 when new findings exist,
+2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+from tools.raylint import core
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.raylint",
+        description="AST-based invariant checker for the ray_tpu runtime.")
+    p.add_argument("paths", nargs="*", default=[],
+                   help="files or directories to lint (default: ray_tpu/)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit a JSON report instead of text")
+    p.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                   help=f"baseline file (default: {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline file from the current findings "
+                        "(review the diff before committing!)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list registered rules and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(core.all_rules().items()):
+            print(f"{name}  {cls.summary}")
+        return 0
+
+    rule_names = None
+    if args.rules:
+        rule_names = [r.strip() for r in args.rules.split(",") if r.strip()]
+
+    if args.write_baseline and (args.paths or rule_names):
+        # a partial run would overwrite the baseline with only its own
+        # subset, silently erasing every other reviewed entry
+        print("raylint: --write-baseline requires a full default run "
+              "(no explicit paths, no --rules)", file=sys.stderr)
+        return 2
+
+    paths = [Path(p) for p in args.paths] or [REPO_ROOT / "ray_tpu"]
+    for p in paths:
+        if not p.exists():
+            print(f"raylint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline = Counter()
+    if not (args.no_baseline or args.write_baseline):
+        if args.baseline.is_file():
+            try:
+                baseline = core.load_baseline(args.baseline)
+            except (ValueError, KeyError) as e:
+                print(f"raylint: bad baseline {args.baseline}: {e}",
+                      file=sys.stderr)
+                return 2
+
+    try:
+        report = core.check_paths(paths, REPO_ROOT, baseline=baseline,
+                                  rule_names=rule_names)
+    except KeyError as e:
+        print(f"raylint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        parse_errors = [f for f in report.findings
+                        if f.rule == core.PARSE_ERROR_RULE]
+        if parse_errors:
+            # grandfathering a parse error would exempt the file from every
+            # rule forever; it must be fixed, not baselined
+            for f in parse_errors:
+                print(f.render(), file=sys.stderr)
+            print("raylint: refusing to write a baseline containing parse "
+                  "errors", file=sys.stderr)
+            return 2
+        args.baseline.write_text(core.dump_baseline(report.findings),
+                                 encoding="utf-8")
+        print(f"raylint: wrote {len(report.findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        for f in report.findings:
+            print(f.render())
+        for rule, path, snippet in report.unused_baseline:
+            print(f"warning: stale baseline entry {rule} at {path}: {snippet!r}",
+                  file=sys.stderr)
+        if report.passed:
+            status = "clean"
+        elif report.ok:
+            status = (f"{len(report.unused_baseline)} stale baseline "
+                      f"entr(y/ies)")
+        else:
+            status = f"{len(report.findings)} finding(s)"
+        print(f"raylint: {report.files_checked} file(s), {status}, "
+              f"{len(report.baselined)} baselined", file=sys.stderr)
+    # stale entries fail too: tier-1 (tests/test_raylint.py) rejects them,
+    # so the CLI must not report a false green
+    return 0 if report.passed else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

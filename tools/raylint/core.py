@@ -1,0 +1,409 @@
+"""raylint core: the rule framework.
+
+This module is deliberately self-contained (stdlib only) so it can run in any
+environment the repo runs in — CI, a dev laptop, or inside a test — with zero
+dependencies on ray_tpu itself. It provides:
+
+* :class:`Finding` — one diagnostic, keyed for baseline matching by
+  ``(rule, path, snippet)`` rather than line number, so baselines survive
+  unrelated edits that shift lines.
+* :class:`Rule` — base class; concrete rules live in ``tools/raylint/rules.py``
+  and register themselves with :func:`register_rule`.
+* Suppressions — ``# raylint: disable=RULE1,RULE2 <reason>`` on (or directly
+  above) the offending line, and ``# raylint: disable-file=RULE`` anywhere in a
+  file. ``disable=all`` suppresses every rule. Comments are found with
+  :mod:`tokenize`, so the directives never fire inside string literals.
+* Baseline — a checked-in JSON file of reviewed, grandfathered findings.
+  Matching consumes entries from a multiset, so *new* occurrences of an
+  already-baselined pattern in the same file still fail.
+* :class:`Project` / :func:`check_paths` — the runner.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from collections import Counter
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+# Rule id for files that fail to parse: a syntax error in the tree is itself a
+# finding (it would otherwise silently exempt the file from every rule).
+PARSE_ERROR_RULE = "E999"
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", ".eggs", "node_modules"}
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    rule: str
+    path: str  # posix path relative to the project root
+    line: int
+    col: int
+    message: str
+    snippet: str  # stripped source of the flagged line; part of the baseline key
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+_RULES: Dict[str, type] = {}
+
+
+def register_rule(cls: type) -> type:
+    """Class decorator: add a Rule subclass to the global registry."""
+    if not cls.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if cls.name in _RULES and _RULES[cls.name] is not cls:
+        raise ValueError(f"duplicate rule id {cls.name}")
+    _RULES[cls.name] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, type]:
+    """Registry of rule id -> class (imports the bundled rule set on first use)."""
+    if not _RULES:
+        from tools.raylint import rules as _  # noqa: F401  (self-registers)
+    return dict(_RULES)
+
+
+class Rule:
+    """One invariant. Subclass, set ``name``/``summary``, implement ``check``."""
+
+    name: str = ""
+    summary: str = ""
+
+    def check(self, module: "Module") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    # -- helpers shared by concrete rules --
+
+    def finding(self, module: "Module", node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        snippet = module.line(line).strip()
+        return Finding(rule=self.name, path=module.path, line=line, col=col,
+                       message=message, snippet=snippet)
+
+
+# ---------------------------------------------------------------------------
+# Import alias resolution (per module)
+# ---------------------------------------------------------------------------
+
+
+class ImportResolver:
+    """Maps local names back to dotted import paths so ``from time import
+    sleep as zzz; zzz()`` still resolves to ``time.sleep``."""
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    # plain `import a.b` binds `a`, which already resolves
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Resolve an expression to a dotted name, or None if it isn't one."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        parts.append(cur.id)
+        parts.reverse()
+        mapped = self.aliases.get(parts[0])
+        if mapped is not None:
+            parts[0:1] = mapped.split(".")
+        return ".".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Suppression comments
+# ---------------------------------------------------------------------------
+
+_DIRECTIVE_RE = re.compile(
+    r"#\s*raylint:\s*disable(?P<filewide>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_*]+(?:\s*,\s*[A-Za-z0-9_*]+)*)")
+
+
+class Suppressions:
+    """Per-line and per-file ``# raylint: disable=...`` directives."""
+
+    def __init__(self, source: str):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.filewide: Set[str] = set()
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            tokens = []
+        code_lines: Set[int] = set()
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                m = _DIRECTIVE_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+                rules = {"all" if r == "*" else r for r in rules}
+                if m.group("filewide"):
+                    self.filewide |= rules
+                else:
+                    self.by_line.setdefault(tok.start[0], set()).update(rules)
+            elif tok.type not in (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                                  tokenize.DEDENT, tokenize.ENDMARKER):
+                code_lines.add(tok.start[0])
+        # a directive on its own line also covers the next code line DIRECTLY
+        # below it (only comment lines may intervene — a blank line breaks the
+        # binding, so a stale directive can't silently drift onto unrelated
+        # code); decorator lines are then descended through so "directly
+        # above" works for decorated defs/classes too (findings anchor at the
+        # def/class line)
+        lines = source.splitlines()
+        last = max(code_lines, default=0)
+
+        def next_adjacent_code_line(after: int) -> int:
+            """First code line after `after` with only comments between, or 0."""
+            nxt = after + 1
+            while nxt <= last:
+                if nxt in code_lines:
+                    return nxt
+                if not lines[nxt - 1].strip().startswith("#"):
+                    return 0  # blank (or other non-comment) line: binding ends
+                nxt += 1
+            return 0
+
+        for ln in sorted(self.by_line):
+            if ln in code_lines:
+                continue
+            nxt = next_adjacent_code_line(ln)
+            while nxt:
+                self.by_line.setdefault(nxt, set()).update(self.by_line[ln])
+                if lines[nxt - 1].lstrip().startswith("@"):
+                    nxt = next_adjacent_code_line(nxt)  # decorator: descend
+                else:
+                    break
+
+    def covers(self, rule: str, line: int) -> bool:
+        if rule in self.filewide or "all" in self.filewide:
+            return True
+        rules = self.by_line.get(line, ())
+        return rule in rules or "all" in rules
+
+
+# ---------------------------------------------------------------------------
+# Module + project
+# ---------------------------------------------------------------------------
+
+
+class Module:
+    """One parsed source file as seen by rules."""
+
+    def __init__(self, path: str, source: str, project: "Project"):
+        self.path = path  # posix, relative to project root
+        self.source = source
+        self.lines = source.splitlines()
+        self.project = project
+        self.tree = ast.parse(source)  # raises SyntaxError; caller handles
+        self.resolver = ImportResolver(self.tree)
+        self.suppressions = Suppressions(source)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def parts(self) -> Tuple[str, ...]:
+        return Path(self.path).parts
+
+
+class Project:
+    """Shared state for one lint run (root dir + per-run rule caches)."""
+
+    def __init__(self, root: Path, rule_names: Optional[Sequence[str]] = None):
+        self.root = Path(root).resolve()
+        registry = all_rules()
+        if rule_names:
+            unknown = set(rule_names) - set(registry)
+            if unknown:
+                raise KeyError(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+            self.rules = [registry[n]() for n in rule_names]
+        else:
+            self.rules = [cls() for cls in registry.values()]
+        self.rules.sort(key=lambda r: r.name)
+        self.cache: Dict[str, object] = {}  # scratch space for project-aware rules
+
+    def relpath(self, path: Path) -> str:
+        p = Path(path).resolve()
+        try:
+            return p.relative_to(self.root).as_posix()
+        except ValueError:
+            return p.as_posix()
+
+    def check_source(self, source: str, relpath: str) -> List[Finding]:
+        """Lint one in-memory source blob (suppressions applied, no baseline)."""
+        try:
+            module = Module(relpath, source, self)
+        except SyntaxError as e:
+            return [Finding(rule=PARSE_ERROR_RULE, path=relpath,
+                            line=e.lineno or 1, col=e.offset or 0,
+                            message=f"syntax error: {e.msg}", snippet="")]
+        except ValueError as e:  # e.g. NUL bytes (ast.parse, py<=3.11)
+            return [Finding(rule=PARSE_ERROR_RULE, path=relpath, line=1,
+                            col=0, message=f"unparseable: {e}", snippet="")]
+        findings: List[Finding] = []
+        for rule in self.rules:
+            for f in rule.check(module):
+                if not module.suppressions.covers(f.rule, f.line):
+                    findings.append(f)
+        findings.sort()
+        return findings
+
+    def check_file(self, path: Path) -> List[Finding]:
+        rel = self.relpath(path)
+        try:
+            source = Path(path).read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            return [Finding(rule=PARSE_ERROR_RULE, path=rel, line=1, col=0,
+                            message=f"unreadable: {e}", snippet="")]
+        return self.check_source(source, rel)
+
+
+def iter_py_files(paths: Iterable[Path]) -> Iterator[Path]:
+    for p in paths:
+        p = Path(p)
+        if p.is_file():
+            if p.suffix == ".py":
+                yield p
+        elif p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                # skip-dir filter applies only BELOW the search root: a repo
+                # checked out under a dot-prefixed ancestor must still lint
+                rel_parts = sub.relative_to(p).parts
+                if not any(part in _SKIP_DIRS or part.startswith(".")
+                           for part in rel_parts):
+                    yield sub
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: Path) -> Counter:
+    """Baseline file -> multiset of (rule, path, snippet) keys."""
+    data = json.loads(Path(path).read_text(encoding="utf-8"))
+    counts: Counter = Counter()
+    for entry in data.get("findings", []):
+        key = (entry["rule"], entry["path"], entry["snippet"])
+        counts[key] += int(entry.get("count", 1))
+    return counts
+
+
+def dump_baseline(findings: Iterable[Finding]) -> str:
+    """Serialize findings as a sorted, deterministic baseline document."""
+    counts: Counter = Counter(f.key() for f in findings)
+    entries = [
+        {"rule": rule, "path": path, "snippet": snippet, "count": n}
+        for (rule, path, snippet), n in sorted(counts.items())
+    ]
+    doc = {
+        "comment": "raylint baseline: reviewed, grandfathered findings. "
+                   "Regenerate with `python -m tools.raylint --write-baseline` "
+                   "only after reviewing every new entry.",
+        "version": BASELINE_VERSION,
+        "findings": entries,
+    }
+    return json.dumps(doc, indent=2, sort_keys=False) + "\n"
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]          # new (non-baselined, non-suppressed)
+    baselined: List[Finding]         # matched a baseline entry
+    unused_baseline: List[Tuple[str, str, str]]  # stale baseline keys
+    files_checked: int
+
+    @property
+    def ok(self) -> bool:
+        """No NEW findings (the tier-1 'is the tree clean' question)."""
+        return not self.findings
+
+    @property
+    def passed(self) -> bool:
+        """The full gate contract: no new findings AND no stale baseline
+        entries. This is what the CLI exit status reflects."""
+        return self.ok and not self.unused_baseline
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.passed,
+            "files_checked": self.files_checked,
+            "findings": [f.to_json() for f in self.findings],
+            "baselined_count": len(self.baselined),
+            "unused_baseline": [
+                {"rule": r, "path": p, "snippet": s}
+                for r, p, s in self.unused_baseline
+            ],
+        }
+
+
+def check_paths(paths: Sequence[Path], root: Path,
+                baseline: Optional[Counter] = None,
+                rule_names: Optional[Sequence[str]] = None) -> Report:
+    project = Project(root, rule_names)
+    raw: List[Finding] = []
+    scanned: Set[str] = set()
+    for f in iter_py_files(paths):
+        rel = project.relpath(f)
+        if rel in scanned:  # overlapping search paths: lint each file once
+            continue
+        scanned.add(rel)
+        raw.extend(project.check_file(f))
+    raw.sort()
+    remaining = Counter(baseline or ())
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    for finding in raw:
+        if remaining.get(finding.key(), 0) > 0:
+            remaining[finding.key()] -= 1
+            matched.append(finding)
+        else:
+            new.append(finding)
+    # an entry is only "stale" if its file was actually scanned AND its rule
+    # actually ran — a subset run (paths or --rules) must not report
+    # out-of-scope entries as stale
+    active = {r.name for r in project.rules}
+    unused = sorted(k for k, n in remaining.items()
+                    if n > 0 and k[0] in active and k[1] in scanned
+                    for _ in range(n))
+    return Report(findings=new, baselined=matched,
+                  unused_baseline=unused, files_checked=len(scanned))

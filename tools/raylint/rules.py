@@ -1,0 +1,429 @@
+"""raylint rule set: the invariants this runtime actually depends on.
+
+Each rule encodes a failure mode we have hit (or designed against) in the
+distributed runtime — see tools/raylint/README.md for the full rationale and
+suppression guidance per rule.
+
+* ASY001 — blocking call inside an ``async def`` body (event-loop stall).
+* ASY002 — ``await`` while holding a ``threading`` lock, or a ``threading``
+  primitive constructed on the event loop where an ``asyncio`` one belongs.
+* SER001 — ``pickle.loads``/``cloudpickle.loads`` outside the sanctioned
+  serialization boundary (``_private/serialization.py``, ``_private/wire.py``).
+* EXC001 — exception-swallowing ``except ...: pass`` on control-plane paths
+  (``_private/``, ``autoscaler/``, ``dag/``) with no log call.
+* WIRE001 — a struct defined in a wire-schema module that is not registered
+  in the ``wire.py`` registry (it would raise WireError at runtime, or worse,
+  tempt someone to pickle it).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Set
+
+from tools.raylint.core import Finding, Module, Rule, register_rule
+
+# ---------------------------------------------------------------------------
+# shared visitor: track whether we are in an async frame
+# ---------------------------------------------------------------------------
+
+
+class _AsyncFrameVisitor(ast.NodeVisitor):
+    """Walks a module tracking the innermost function frame. ``in_async`` is
+    True only when the nearest enclosing function is an ``async def`` — code
+    inside a nested sync ``def`` or ``lambda`` runs off the loop (e.g. an
+    executor thunk) and is NOT async context."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.frames: List[str] = []  # "async" | "sync"
+        self.findings: List[Finding] = []
+
+    @property
+    def in_async(self) -> bool:
+        return bool(self.frames) and self.frames[-1] == "async"
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self.frames.append("async")
+        self.generic_visit(node)
+        self.frames.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.frames.append("sync")
+        self.generic_visit(node)
+        self.frames.pop()
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self.frames.append("sync")
+        self.generic_visit(node)
+        self.frames.pop()
+
+
+def _contains_await(nodes) -> bool:
+    """True if an await/async-for/async-with occurs in these nodes WITHOUT
+    crossing into a nested function definition."""
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Await, ast.AsyncFor, ast.AsyncWith)):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _terminal(dotted: Optional[str]) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _is_lock_like(node: ast.AST, resolver) -> bool:
+    """Heuristic: an expression that names a mutex (``self._lock``,
+    ``_exec_lock``, ``store.mutex`` ...) — but not e.g. ``self.block``."""
+    dotted = resolver.dotted(node)
+    name = _terminal(dotted).lower()
+    return (name in ("lock", "rlock", "mutex")
+            or name.endswith(("_lock", "_rlock", "_mutex")))
+
+
+# ---------------------------------------------------------------------------
+# ASY001 — blocking calls in async bodies
+# ---------------------------------------------------------------------------
+
+# dotted call -> remediation hint. Every one of these parks the entire event
+# loop (every actor task, RPC reply, and heartbeat on this node) until it
+# returns.
+_BLOCKING_CALLS: Dict[str, str] = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.call": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.getoutput": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.getstatusoutput": "use `asyncio.create_subprocess_exec` or an executor",
+    "os.system": "use `asyncio.create_subprocess_shell` or an executor",
+    "os.wait": "use `asyncio.create_subprocess_exec` and await it",
+    "os.waitpid": "use `asyncio.create_subprocess_exec` and await it",
+    "urllib.request.urlopen": "run it in an executor thread",
+    "requests.get": "run it in an executor thread",
+    "requests.post": "run it in an executor thread",
+    "requests.put": "run it in an executor thread",
+    "requests.patch": "run it in an executor thread",
+    "requests.delete": "run it in an executor thread",
+    "requests.head": "run it in an executor thread",
+    "requests.request": "run it in an executor thread",
+    "socket.create_connection": "use `asyncio.open_connection`",
+    "ray_tpu.get": "a cluster round-trip blocks the loop; await the async "
+                   "API or wrap in `loop.run_in_executor`",
+    "ray_tpu.wait": "a cluster round-trip blocks the loop; await the async "
+                    "API or wrap in `loop.run_in_executor`",
+}
+
+# method names that block when called on a raw socket; only flagged when the
+# receiver's name mentions a socket, to keep the false-positive rate near zero
+_SOCKET_METHODS = {"recv", "recv_into", "accept", "sendall", "makefile"}
+
+
+@register_rule
+class BlockingCallInAsync(Rule):
+    name = "ASY001"
+    summary = ("blocking call inside `async def`: stalls every task, RPC and "
+               "heartbeat sharing this event loop")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        rule = self
+
+        class V(_AsyncFrameVisitor):
+            def visit_Call(self, node: ast.Call):
+                if self.in_async:
+                    dotted = module.resolver.dotted(node.func)
+                    hint = _BLOCKING_CALLS.get(dotted or "")
+                    if hint is not None:
+                        self.findings.append(rule.finding(
+                            module, node,
+                            f"blocking `{dotted}(...)` in async context; {hint}"))
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr in _SOCKET_METHODS):
+                        recv = module.resolver.dotted(node.func.value) or ""
+                        if "sock" in recv.lower():
+                            self.findings.append(rule.finding(
+                                module, node,
+                                f"blocking socket op `.{node.func.attr}(...)` in "
+                                f"async context; use asyncio streams"))
+                self.generic_visit(node)
+
+        v = V(module)
+        v.visit(module.tree)
+        return iter(v.findings)
+
+
+# ---------------------------------------------------------------------------
+# ASY002 — threading primitives on the event loop
+# ---------------------------------------------------------------------------
+
+_THREADING_PRIMITIVES = {
+    "threading.Lock": "asyncio.Lock",
+    "threading.RLock": "asyncio.Lock",
+    "threading.Condition": "asyncio.Condition",
+    "threading.Semaphore": "asyncio.Semaphore",
+    "threading.BoundedSemaphore": "asyncio.Semaphore",
+    "threading.Event": "asyncio.Event",
+    "threading.Barrier": "asyncio.Barrier",
+}
+
+
+@register_rule
+class AwaitUnderThreadLock(Rule):
+    name = "ASY002"
+    summary = ("`await` while holding a threading lock (cross-thread "
+               "deadlock), or a threading primitive where an asyncio one "
+               "belongs")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        rule = self
+        awaited: Set[int] = {
+            id(n.value) for n in ast.walk(module.tree) if isinstance(n, ast.Await)
+        }
+
+        class V(_AsyncFrameVisitor):
+            def visit_With(self, node: ast.With):
+                if self.in_async:
+                    for item in node.items:
+                        expr = item.context_expr
+                        # `with lock:` — a Call like `lock.acquire_timeout()`
+                        # is out of scope; names/attrs only
+                        if isinstance(expr, (ast.Name, ast.Attribute)) \
+                                and _is_lock_like(expr, module.resolver) \
+                                and _contains_await(node.body):
+                            self.findings.append(rule.finding(
+                                module, node,
+                                "await inside `with <threading lock>`: the "
+                                "loop thread parks while holding the lock — "
+                                "any thread that then takes the lock and "
+                                "schedules loop work deadlocks; use "
+                                "`asyncio.Lock` or release before awaiting"))
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call):
+                if self.in_async:
+                    dotted = module.resolver.dotted(node.func)
+                    repl = _THREADING_PRIMITIVES.get(dotted or "")
+                    if repl:
+                        self.findings.append(rule.finding(
+                            module, node,
+                            f"`{dotted}()` constructed in async context; its "
+                            f"blocking acquire/wait would stall the loop — "
+                            f"use `{repl}`"))
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "acquire"
+                          and id(node) not in awaited
+                          and _is_lock_like(node.func.value, module.resolver)):
+                        self.findings.append(rule.finding(
+                            module, node,
+                            "non-awaited `.acquire()` on a lock in async "
+                            "context blocks the event loop; use "
+                            "`async with` / `await lock.acquire()`"))
+                self.generic_visit(node)
+
+        v = V(module)
+        v.visit(module.tree)
+        return iter(v.findings)
+
+
+# ---------------------------------------------------------------------------
+# SER001 — unpickling outside the serialization boundary
+# ---------------------------------------------------------------------------
+
+_UNPICKLE_CALLS = {
+    "pickle.loads", "pickle.load", "pickle.Unpickler",
+    "cloudpickle.loads", "cloudpickle.load",
+}
+
+# The ONLY modules allowed to unpickle: the object-plane serializer and the
+# typed wire codec (which by construction never unpickles network input).
+_SER_ALLOWLIST = {
+    "ray_tpu/_private/serialization.py",
+    "ray_tpu/_private/wire.py",
+}
+
+
+@register_rule
+class UnpickleOutsideBoundary(Rule):
+    name = "SER001"
+    summary = ("pickle/cloudpickle load outside _private/serialization.py: "
+               "unpickling network input is remote code execution")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if module.path in _SER_ALLOWLIST:
+            return iter(())
+        findings = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                dotted = module.resolver.dotted(node.func)
+                if dotted in _UNPICKLE_CALLS:
+                    findings.append(self.finding(
+                        module, node,
+                        f"`{dotted}(...)` outside the serialization boundary; "
+                        f"route through ray_tpu._private.serialization (e.g. "
+                        f"`loads_trusted`) so every unpickle site is auditable"))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# EXC001 — swallowed exceptions on the control plane
+# ---------------------------------------------------------------------------
+
+# Handler types that are control flow, not error swallowing, when caught
+# alone: bounded waits and lookup misses.
+_EXC_EXEMPT = {
+    "asyncio.TimeoutError", "TimeoutError", "concurrent.futures.TimeoutError",
+    "asyncio.CancelledError", "CancelledError",
+    "KeyError", "IndexError", "FileNotFoundError",
+    "StopIteration", "StopAsyncIteration", "GeneratorExit",
+    "queue.Empty", "queue.Full",
+}
+
+# Path components that mark control-plane code. A stall or swallowed error
+# here takes down scheduling/heartbeats for the whole node, not one task.
+_EXC_PATH_PARTS = {"_private", "autoscaler", "dag"}
+
+
+def _handler_types(handler: ast.ExceptHandler, resolver) -> List[Optional[str]]:
+    t = handler.type
+    if t is None:
+        return [None]  # bare except
+    if isinstance(t, ast.Tuple):
+        return [resolver.dotted(e) for e in t.elts]
+    return [resolver.dotted(t)]
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """Body is nothing but pass / ... / continue / break / bare return —
+    i.e. the error is dropped without a trace (a `return value` or any other
+    statement at least does something with the failure)."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and stmt.value is None:
+            continue
+        if (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+@register_rule
+class SwallowedException(Rule):
+    name = "EXC001"
+    summary = ("`except ...: pass` on a control-plane path with no log call: "
+               "the next symptom is a distributed hang with no trace")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not (_EXC_PATH_PARTS & set(module.parts())):
+            return iter(())
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler) or not _swallows(node):
+                continue
+            types = _handler_types(node, module.resolver)
+            if all(t is not None and t in _EXC_EXEMPT for t in types):
+                continue
+            shown = ", ".join(t or "<bare>" for t in types)
+            findings.append(self.finding(
+                module, node,
+                f"swallowed `except {shown}` with no log call; add "
+                f"`logger.debug(...)` with context, or suppress with a reason "
+                f"(`# raylint: disable=EXC001 <why>`)"))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# WIRE001 — wire structs missing from the registry
+# ---------------------------------------------------------------------------
+
+# Modules whose dataclasses ARE the control-plane schema: anything defined
+# here is meant to cross RPC, so it must be in wire.py's registry or be
+# explicitly annotated as process-local.
+_WIRE_STRUCT_MODULES = {
+    "ray_tpu/_private/common.py",
+    "ray_tpu/util/scheduling_strategies.py",
+}
+_WIRE_REGISTRY_MODULE = "ray_tpu/_private/wire.py"
+_WIRE_CACHE_KEY = "wire001.registered"
+
+
+def _registered_wire_names(project) -> Set[str]:
+    """Parse wire.py and collect every class name passed (directly, or via a
+    registration loop) to register_struct/register_id."""
+    cached = project.cache.get(_WIRE_CACHE_KEY)
+    if cached is not None:
+        return cached
+    names: Set[str] = set()
+    path = project.root / _WIRE_REGISTRY_MODULE
+    if path.is_file():
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+
+        def is_register(call: ast.Call) -> bool:
+            fn = call.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else ""
+            return attr in ("register_struct", "register_id")
+
+        def terminal_name(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, ast.Attribute):
+                return expr.attr
+            if isinstance(expr, ast.Name):
+                return expr.id
+            return None
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and is_register(node) and node.args:
+                n = terminal_name(node.args[0])
+                if n:
+                    names.add(n)
+            elif isinstance(node, ast.For):
+                # `for c in (ids.JobID, ...): register_id(c)`
+                has_register = any(
+                    isinstance(sub, ast.Call) and is_register(sub)
+                    for sub in ast.walk(node))
+                if has_register and isinstance(node.iter, (ast.Tuple, ast.List)):
+                    for elt in node.iter.elts:
+                        n = terminal_name(elt)
+                        if n:
+                            names.add(n)
+    project.cache[_WIRE_CACHE_KEY] = names
+    return names
+
+
+def _is_dataclass_decorated(node: ast.ClassDef, resolver) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = resolver.dotted(target) or ""
+        if dotted in ("dataclass", "dataclasses.dataclass"):
+            return True
+    return False
+
+
+@register_rule
+class UnregisteredWireStruct(Rule):
+    name = "WIRE001"
+    summary = ("dataclass in a wire-schema module missing from the wire.py "
+               "registry: sending it raises WireError at runtime")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if module.path not in _WIRE_STRUCT_MODULES:
+            return iter(())
+        registered = _registered_wire_names(module.project)
+        findings = []
+        for node in module.tree.body:
+            if (isinstance(node, ast.ClassDef)
+                    and _is_dataclass_decorated(node, module.resolver)
+                    and node.name not in registered):
+                findings.append(self.finding(
+                    module, node,
+                    f"wire-schema dataclass `{node.name}` is not registered in "
+                    f"wire.py (_register_builtin_types); register it, or mark "
+                    f"it process-local with `# raylint: disable=WIRE001 <why>`"))
+        return iter(findings)
