@@ -101,6 +101,26 @@ def _register_builtin_types():
         PlacementGroup, fields=("id", "bundle_specs"),
         decode=lambda f: PlacementGroup(f["id"], f["bundle_specs"]))
 
+    # weight plane (ray_tpu/weights/): mesh geometry + transfer-plan edges
+    # cross the control plane (store manifests, dashboard stats)
+    from ray_tpu.weights.plan import TransferEdge
+    from ray_tpu.weights.spec import MeshSpec
+
+    register_struct(
+        MeshSpec, fields=("shape", "axis_names", "hosts"),
+        decode=lambda f: MeshSpec(tuple(f["shape"]), tuple(f["axis_names"]),
+                                  tuple(f["hosts"])))
+    register_struct(
+        TransferEdge,
+        fields=("leaf", "src_host", "dst_host", "box", "src_box", "dst_box",
+                "nbytes", "local"),
+        decode=lambda f: TransferEdge(
+            leaf=f["leaf"], src_host=f["src_host"], dst_host=f["dst_host"],
+            box=tuple(tuple(p) for p in f["box"]),
+            src_box=tuple(tuple(p) for p in f["src_box"]),
+            dst_box=tuple(tuple(p) for p in f["dst_box"]),
+            nbytes=f["nbytes"], local=f["local"]))
+
 
 # ---------------------------------------------------------------------------
 # Codec

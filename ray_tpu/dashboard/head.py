@@ -88,6 +88,7 @@ class DashboardHead:
             web.get("/metrics", self._prometheus),
             web.get("/api/nodes/{node_id}/stats", self._node_stats),
             web.get("/api/data_stats", self._data_stats),
+            web.get("/api/weights", self._weights),
             web.post("/api/profile/stacks", self._profile_stacks),
             web.post("/api/profile/memory", self._profile_memory),
             web.get("/api/jobs", self._jobs_list),
@@ -168,6 +169,22 @@ class DashboardHead:
                 entry["dataset"] = k
                 out.append(entry)
         out.sort(key=lambda e: e.get("ts", 0))
+        return web.json_response(out)
+
+    async def _weights(self, request):
+        """Weight-plane stores: per-version publish/pull bytes, chunk
+        counts, commit timestamps (mirrored to the ``weights`` KV namespace
+        by WeightStoreActor on every commit/pull)."""
+        from aiohttp import web
+
+        keys = (await self._call("KVKeys",
+                                 {"ns": "weights", "prefix": ""}))["keys"]
+        out = {}
+        for k in keys:
+            blob = (await self._call("KVGet",
+                                     {"ns": "weights", "key": k}))["value"]
+            if blob is not None:
+                out[k] = wire.loads(blob)
         return web.json_response(out)
 
     async def _node_stats(self, request):

@@ -12,10 +12,12 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-import cloudpickle
 import numpy as np
 
 import ray_tpu
+# driver-authored UDF/spec blobs: every decode goes through the audited
+# serialization boundary (raylint SER001) instead of raw cloudpickle
+from ray_tpu._private.serialization import loads_trusted
 from ray_tpu.data.block import Block, BlockAccessor
 
 
@@ -70,7 +72,7 @@ def apply_chain(block: Block, chain: List[tuple], init_state: dict) -> Block:
 @ray_tpu.remote
 def map_block(chain_blob: bytes, block: Block) -> Tuple[Block, dict]:
     t0 = time.perf_counter()
-    chain = cloudpickle.loads(chain_blob)
+    chain = loads_trusted(chain_blob)
     out = apply_chain(block, chain, {})
     return out, _meta(out, t0)
 
@@ -78,7 +80,7 @@ def map_block(chain_blob: bytes, block: Block) -> Tuple[Block, dict]:
 @ray_tpu.remote
 def read_block(thunk_blob: bytes) -> Tuple[Block, dict]:
     t0 = time.perf_counter()
-    thunk = cloudpickle.loads(thunk_blob)
+    thunk = loads_trusted(thunk_blob)
     out = thunk()
     return out, _meta(out, t0)
 
@@ -89,13 +91,13 @@ class MapWorker:
     (reference: ActorPoolMapOperator's _MapWorker)."""
 
     def __init__(self, ctors_blob: bytes):
-        ctors: Dict[str, tuple] = cloudpickle.loads(ctors_blob)
+        ctors: Dict[str, tuple] = loads_trusted(ctors_blob)
         self._state = {name: cls(*args, **kwargs)
                        for name, (cls, args, kwargs) in ctors.items()}
 
     def map_block(self, chain_blob: bytes, block: Block) -> Tuple[Block, dict]:
         t0 = time.perf_counter()
-        chain = cloudpickle.loads(chain_blob)
+        chain = loads_trusted(chain_blob)
         out = apply_chain(block, chain, self._state)
         return out, _meta(out, t0)
 
@@ -113,7 +115,7 @@ def shuffle_map(block: Block, part_fn_blob: bytes, num_parts: int) -> List[Block
     """Partition one block into ``num_parts`` sub-blocks (hash/range/random).
     Returns a list-block of sub-blocks (kept as ONE object; the reduce task
     indexes into it) — avoids num_returns fan-out on the object store."""
-    part_fn = cloudpickle.loads(part_fn_blob)
+    part_fn = loads_trusted(part_fn_blob)
     acc = BlockAccessor(block)
     rows = acc.to_rows()
     parts: List[List[Any]] = [[] for _ in range(num_parts)]
@@ -128,7 +130,7 @@ def shuffle_reduce(reduce_fn_blob: bytes, part_index: int,
     """Concatenate partition ``part_index`` from every map output and apply
     the reduce fn (sort slice, aggregate, identity...)."""
     t0 = time.perf_counter()
-    reduce_fn = cloudpickle.loads(reduce_fn_blob)
+    reduce_fn = loads_trusted(reduce_fn_blob)
     rows: List[Any] = []
     for parts in map_outputs:
         rows.extend(BlockAccessor(parts[part_index]).to_rows())
@@ -141,7 +143,7 @@ def shuffle_reduce(reduce_fn_blob: bytes, part_index: int,
 def sample_boundaries(key_blob: bytes, num_parts: int,
                       *blocks: Block) -> List[Any]:
     """Sample sort keys to pick range-partition boundaries."""
-    key = cloudpickle.loads(key_blob)
+    key = loads_trusted(key_blob)
     samples: List[Any] = []
     for b in blocks:
         rows = BlockAccessor(b).to_rows()
@@ -162,7 +164,7 @@ def join_reduce(join_spec_blob: bytes, part_index: int,
     are the left side, the rest the right (reference: joins ride the same
     hash shuffle as groupby — operators/join.py)."""
     t0 = time.perf_counter()
-    on, how, suffix = cloudpickle.loads(join_spec_blob)
+    on, how, suffix = loads_trusted(join_spec_blob)
     left_rows: List[dict] = []
     right_rows: List[dict] = []
     for i, parts in enumerate(map_outputs):
@@ -200,7 +202,7 @@ def zip_aligned(left: Block, spans_blob: bytes,
     """Zip one left block against the right-side row ranges covering it
     ((skip, take) per right block, planned from row counts)."""
     t0 = time.perf_counter()
-    spans: List[Tuple[int, int]] = cloudpickle.loads(spans_blob)
+    spans: List[Tuple[int, int]] = loads_trusted(spans_blob)
     lrows = BlockAccessor(left).to_rows()
     rrows: List[Any] = []
     for rb, (skip, take) in zip(right_blocks, spans):
@@ -230,7 +232,7 @@ def slice_block(block: Block, start: int, end: int) -> Tuple[Block, dict]:
 def write_block(block: Block, write_fn_blob: bytes,
                 index: int) -> Tuple[Block, dict]:
     t0 = time.perf_counter()
-    write_fn = cloudpickle.loads(write_fn_blob)
+    write_fn = loads_trusted(write_fn_blob)
     path = write_fn(block, index)
     out = BlockAccessor.build_from_rows([{"path": path}])
     return out, _meta(out, t0)

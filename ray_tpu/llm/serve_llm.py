@@ -105,6 +105,25 @@ class LLMServer:
                 "choices": [{"index": 0, "text": out["text"],
                              "finish_reason": out["finish_reason"]}]}
 
+    async def update_weights(self, store_name: str,
+                             version: Optional[int] = None) -> dict:
+        """Live weight update from the weight plane: pull ``version``
+        (default: newest) from the named WeightStore and swap engine params
+        between steps. In-flight requests keep decoding — the swap is one
+        attribute assignment on the pump's thread boundary, so no request
+        is dropped or restarted. Rolled out across replicas with
+        ``handle.broadcast("update_weights", store_name)``."""
+        loop = asyncio.get_event_loop()
+
+        def _pull():
+            from ray_tpu.weights import WeightStore
+
+            return WeightStore(store_name).pull(version, return_version=True)
+
+        tree, ver = await loop.run_in_executor(None, _pull)
+        self.engine.params = tree
+        return {"version": ver, "model_id": self.config.model_id}
+
     def engine_metrics(self) -> dict:
         return dict(self.engine.metrics)
 

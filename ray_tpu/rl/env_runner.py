@@ -47,6 +47,43 @@ def true_next_obs(step_obs: np.ndarray, done: np.ndarray, info: dict
     return out
 
 
+class WeightSync:
+    """Env-runner-side weight subscription over the weight plane.
+
+    An env-runner constructs one of these next to its policy and calls
+    :meth:`poll` between rollouts: if the learner published a newer version
+    to the store, ``apply_fn(tree)`` installs it and the new version number
+    is returned (None otherwise). Versions are monotonic — a runner can
+    never regress to older weights, and N runners pulling the same version
+    fan out over the store's owner-tracked chunk refs (no learner-side
+    per-runner serialization).
+    """
+
+    def __init__(self, store_name: str, apply_fn=None, start_after: int = -1):
+        from ray_tpu.weights import WeightStore
+
+        self._store = WeightStore(store_name)
+        self._sub = self._store.subscribe(start_after=start_after)
+        self._apply = apply_fn
+        self.weights = None
+        self.version = start_after
+
+    def poll(self, timeout: float = 0.0) -> Optional[int]:
+        """Install the newest published weights if any. ``timeout`` > 0
+        long-polls the store (blocking wait for the next publish)."""
+        out = self._sub.poll(timeout=timeout)
+        if out is None:
+            return None
+        version, tree = out
+        assert version > self.version, (version, self.version)
+        self.version = version
+        if self._apply is not None:
+            self._apply(tree)
+        else:
+            self.weights = tree
+        return version
+
+
 class EpisodeTracker:
     """Accumulates per-env returns; pops finished-episode returns."""
 

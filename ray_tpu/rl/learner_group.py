@@ -81,6 +81,19 @@ class _LearnerWorker:
         jax = import_jax()
         return jax.tree.map(np.asarray, self.core.get_params())
 
+    def publish_weights(self, store_name: str, version=None,
+                        durable: bool = False) -> int:
+        """Publish current params to the named WeightStore from INSIDE the
+        learner — the driver never relays weight bytes. Env-runners pull
+        via weights.WeightSync (see env_runner.py)."""
+        from ray_tpu.utils import import_jax
+        from ray_tpu.weights import WeightStore
+
+        jax = import_jax()
+        params = jax.tree.map(np.asarray, self.core.get_params())
+        return WeightStore(store_name).publish(params, version=version,
+                                               durable=durable)
+
     def get_state(self):
         return self.core.get_state()
 
@@ -155,6 +168,17 @@ class LearnerGroup:
         ref = ray_tpu.put(state)
         ray_tpu.get([w.set_state.remote(ref) for w in self.workers],
                     timeout=300)
+
+    def publish_weights(self, store_name: str, version=None,
+                        durable: bool = False) -> int:
+        """Broadcast current params through the weight plane: rank 0
+        publishes (learner params are replicated by the sync contract) and
+        every subscribed env-runner pulls the new version. Returns the
+        published version (monotonic per store)."""
+        return ray_tpu.get(
+            self.workers[0].publish_weights.remote(store_name, version,
+                                                   durable),
+            timeout=300)
 
     def foreach_learner(self, method: str, *args, **kwargs) -> List[Any]:
         return ray_tpu.get(

@@ -688,6 +688,24 @@ class DeploymentHandle:
             replica = self._pick()
         return self._dispatch(replica, args, kwargs)
 
+    def broadcast(self, method_name: str, *args, timeout: float = 120.0,
+                  **kwargs) -> List[Any]:
+        """Invoke ``method_name`` once on EVERY current replica (bypasses
+        routing). This is the live weight-update primitive: replicas keep
+        serving while each applies the call — e.g.
+        ``handle.broadcast("update_weights", store_name)`` makes every
+        replica pull the newest version from a WeightStore with zero
+        dropped requests (the method runs as one more actor task on the
+        replica's queue; nothing restarts). Returns one result per replica.
+        """
+        self._refresh(force=True)
+        if not self._replicas:
+            raise RuntimeError(f"deployment {self._name} has no replicas")
+        blob = cloudpickle.dumps((args, kwargs))
+        refs = [r.handle_request.remote(method_name, blob)
+                for r in self._replicas]
+        return ray_tpu.get(refs, timeout=timeout)
+
     def _dispatch(self, replica, args, kwargs):
         # pending counters decay by zeroing at each periodic refresh
         self._pending[replica] = self._pending.get(replica, 0) + 1

@@ -101,5 +101,22 @@ def make_scaling_policy(scaling: ScalingConfig) -> ScalingPolicy:
     return FixedScalingPolicy(scaling)
 
 
+def mesh_spec_for(num_workers: int, axis: str = "data"):
+    """The weight-plane mesh a worker group of this size forms: a 1-D mesh
+    with one device per worker, host ids ``rank<i>``.
+
+    This is the re-form contract for elastic resharding: an incarnation of
+    size N publishes its sharded state against ``mesh_spec_for(N)``; the
+    re-formed incarnation of size M (a DIFFERENT mesh-shaped size chosen by
+    the scaling policy) pulls against ``mesh_spec_for(M)`` and the planner
+    moves only the shard slices that change hosts — no rank ever gathers
+    the full state (see ray_tpu/weights/README.md).
+    """
+    from ray_tpu.weights.spec import MeshSpec
+
+    return MeshSpec(shape=(num_workers,), axis_names=(axis,),
+                    hosts=tuple(f"rank{i}" for i in range(num_workers)))
+
+
 def sized(scaling: ScalingConfig, num_workers: int) -> ScalingConfig:
     return replace(scaling, num_workers=num_workers)

@@ -69,8 +69,12 @@ class TrainWorker:
     def run(self, fn_blob: bytes, config: Optional[dict], controller,
             latest_checkpoint_path: Optional[str], run_dir: str,
             dataset_shard_blob: Optional[bytes]) -> Dict[str, Any]:
-        fn = cloudpickle.loads(fn_blob)
-        shards = cloudpickle.loads(dataset_shard_blob) if dataset_shard_blob else {}
+        # driver-authored blobs: decode only through the audited
+        # serialization boundary (raylint SER001)
+        from ray_tpu._private.serialization import loads_trusted
+
+        fn = loads_trusted(fn_blob)
+        shards = loads_trusted(dataset_shard_blob) if dataset_shard_blob else {}
         ctx = TrainContext(
             rank=self.rank,
             world_size=self.world_size,
@@ -92,6 +96,64 @@ class TrainWorker:
             return {"rank": self.rank, "result": result}
         finally:
             set_context(None)
+
+    # -- weight plane (ray_tpu/weights/): elastic state hand-off ---------
+
+    def publish_weight_shards(self, store_name: str, version: int,
+                              shard_tree: Any, durable: bool = True) -> int:
+        """Publish this rank's shard of the training state (every leaf
+        sharded equally along dim 0 across the group). ``durable=True``
+        routes the bytes through the store actor so the published version
+        outlives this worker — the elastic re-form path: a killed group's
+        surviving state is pulled back by the NEXT incarnation, resharded
+        onto its (smaller) mesh, via ``pull_weight_shards``."""
+        from ray_tpu.train.scaling_policy import mesh_spec_for
+        from ray_tpu.weights import (ShardedTreeSpec, WeightStore,
+                                     publish_host_shards)
+        from ray_tpu.weights.spec import flatten_tree, host_boxes
+        import numpy as np
+
+        mesh = mesh_spec_for(self.world_size)
+        skeleton, leaves = flatten_tree(shard_tree)
+        parts, meta, shards = {}, {}, {}
+        host = mesh.hosts[self.rank]
+        for path, leaf in leaves.items():
+            arr = np.asarray(leaf)
+            parts[path] = ("data",) + (None,) * (arr.ndim - 1)
+            meta[path] = ((arr.shape[0] * self.world_size,) + arr.shape[1:],
+                          arr.dtype.str)
+        spec = ShardedTreeSpec(mesh=mesh, parts=parts, meta=meta)
+        for path, leaf in leaves.items():
+            box = host_boxes(spec.mesh, parts[path], meta[path][0], host)[0]
+            shards[path] = {box: np.asarray(leaf)}
+        publish_host_shards(WeightStore(store_name), version, spec, host,
+                            shards, skeleton=skeleton, durable=durable)
+        return version
+
+    def pull_weight_shards(self, store_name: str,
+                           version: Optional[int] = None) -> Dict[str, Any]:
+        """Pull this rank's shard of the newest published state, resharded
+        onto THIS group's mesh (the publisher's world size may differ —
+        that is the point). Returns ``{"version": v, "tree": shard_tree}``
+        with each leaf's dim 0 sized for this world."""
+        from ray_tpu.train.scaling_policy import mesh_spec_for
+        from ray_tpu.weights import ShardedTreeSpec, WeightStore
+        from ray_tpu.weights.spec import unflatten_tree
+        from ray_tpu.weights.store import _spec_from_payload
+
+        store = WeightStore(store_name)
+        man = store.manifest(version)
+        src = _spec_from_payload(man["spec"])
+        mesh = mesh_spec_for(self.world_size)
+        dst = ShardedTreeSpec(
+            mesh=mesh,
+            parts={p: ("data",) + (None,) * (len(shape) - 1)
+                   for p, (shape, _) in src.meta.items()},
+            meta=dict(src.meta))
+        shards, ver = store.pull_shards(dst, mesh.hosts[self.rank],
+                                        man["version"], return_version=True)
+        leaves = {p: next(iter(boxes.values())) for p, boxes in shards.items()}
+        return {"version": ver, "tree": unflatten_tree(man["skeleton"], leaves)}
 
     def shutdown(self):
         return True

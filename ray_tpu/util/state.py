@@ -89,6 +89,25 @@ def list_dataset_stats() -> List[Dict[str, Any]]:
     return out
 
 
+def list_weight_stores() -> Dict[str, Any]:
+    """Weight-plane transfer stats per store (reference surface: the
+    dashboard's /api/weights): per-version bytes published/pulled, chunk
+    counts, commit timestamps — mirrored to GCS KV ns="weights" by
+    WeightStoreActor (ray_tpu/weights/store.py) on every commit/pull."""
+    from ray_tpu._private import worker as worker_mod
+
+    core = worker_mod.global_worker()
+    keys = core._run(core._gcs_call(
+        "KVKeys", {"ns": "weights", "prefix": ""}))["keys"]
+    out = {}
+    for k in keys:
+        blob = core._run(core._gcs_call(
+            "KVGet", {"ns": "weights", "key": k}))["value"]
+        if blob is not None:
+            out[k] = wire.loads(blob)
+    return out
+
+
 def summarize_cluster() -> Dict[str, Any]:
     state = _state()
     actors_by_state: Dict[str, int] = {}

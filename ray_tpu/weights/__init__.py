@@ -1,0 +1,68 @@
+"""ray_tpu.weights: mesh-aware sharded weight transfer and live resharding.
+
+The weight plane moves sharded model state between actors — learner ->
+env-runners, train mesh -> serve replicas, old mesh -> re-formed elastic
+mesh — without ever materializing a full array on one host. See
+``ray_tpu/weights/README.md`` for the design.
+
+Public surface::
+
+    from ray_tpu import weights
+
+    spec = weights.ShardedTreeSpec.from_tree(tree, mesh, parts={...})
+    plan = weights.plan_reshard(src_spec, dst_spec)   # inspectable
+    store = weights.WeightStore("policy")             # named, in GCS
+    v = store.publish(tree)                           # broadcast source
+    weights.publish_host_shards(store, v2, spec, host, shards)  # mesh source
+    tree = store.pull()                               # replicated consumer
+    shards = store.pull_shards(dst_spec, host)        # sharded consumer
+    sub = store.subscribe(); sub.poll(timeout=10)     # long-poll updates
+"""
+
+# Lazy exports (PEP 562): wire.py registers MeshSpec/TransferEdge on first
+# control-plane encode in EVERY process, which imports this package — the
+# store/transport tiers (and their numpy import) must not ride along into
+# processes that never move weights.
+_EXPORTS = {
+    "TransferEdge": "plan", "TransferPlan": "plan", "plan_reshard": "plan",
+    "MeshSpec": "spec", "ShardedTreeSpec": "spec",
+    "flatten_tree": "spec", "unflatten_tree": "spec",
+    "WeightStore": "store", "WeightStoreActor": "store",
+    "WeightSubscription": "store",
+    "collective_reshard": "transport", "jax_reshard": "transport",
+    "local_shards_of": "transport", "publish_host_shards": "transport",
+    "pull_with_locals": "transport",
+}
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'ray_tpu.weights' has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"ray_tpu.weights.{mod}"), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
+
+__all__ = [
+    "MeshSpec",
+    "ShardedTreeSpec",
+    "TransferEdge",
+    "TransferPlan",
+    "WeightStore",
+    "WeightStoreActor",
+    "WeightSubscription",
+    "plan_reshard",
+    "flatten_tree",
+    "unflatten_tree",
+    "local_shards_of",
+    "publish_host_shards",
+    "pull_with_locals",
+    "collective_reshard",
+    "jax_reshard",
+]

@@ -1,0 +1,206 @@
+"""Resharding planner: (src spec, dst spec) -> minimal shard-exchange plan.
+
+Reference: "Memory-efficient array redistribution through portable
+collective communication" (PAPERS.md) — a reshard is a set of slice
+exchanges computed from the two index geometries; no step of the exchange
+may materialize the full array on one participant. The planner works purely
+on :mod:`ray_tpu.weights.spec` geometry:
+
+- For every leaf, the distinct source shard boxes form a disjoint grid and
+  so do the destination boxes; each (dst box ∩ src box) intersection becomes
+  exactly ONE :class:`TransferEdge` per destination host that needs it.
+- A destination host that already holds the bytes (it is also a source
+  replica of the intersecting box) gets a ``local`` edge — zero bytes moved.
+- When an intersection has several source replicas, the source host is
+  chosen by a stable hash of the chunk key, so (a) fan-out spreads across
+  replicas instead of hammering host 0 and (b) every destination of the
+  same chunk pulls from the SAME source — the chunk is published once.
+
+The resulting plan is transport-agnostic: ``transport.py`` lowers edges to
+the collective tier (same mesh) or to chunked object-plane puts/pulls.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.weights.spec import (
+    Box,
+    MeshSpec,
+    ShardedTreeSpec,
+    box_nbytes,
+    host_boxes,
+    intersect_box,
+    unique_boxes,
+)
+
+
+@dataclass(frozen=True)
+class TransferEdge:
+    """One chunk movement: ``box`` (global coords) of ``leaf`` travels from
+    ``src_host`` to ``dst_host``. ``src_box`` is the source shard the chunk
+    is cut from; ``dst_box`` the destination shard it lands in.
+    (wire-registered; see wire.py)"""
+
+    leaf: str
+    src_host: str
+    dst_host: str
+    box: Box
+    src_box: Box
+    dst_box: Box
+    nbytes: int
+    local: bool
+
+    def chunk_key(self) -> str:
+        """Deterministic manifest key for this chunk's bytes. Keyed by leaf
+        + box only: replicated destinations share one published chunk."""
+        flat = ",".join(f"{a}:{b}" for a, b in self.box)
+        return f"{self.leaf}|{flat}"
+
+
+@dataclass
+class TransferPlan:
+    src: ShardedTreeSpec
+    dst: ShardedTreeSpec
+    edges: List[TransferEdge] = field(default_factory=list)
+
+    # -- per-host views (what transports consume) --
+
+    def sends_from(self, host: str) -> List[TransferEdge]:
+        return [e for e in self.edges if e.src_host == host and not e.local]
+
+    def recvs_to(self, host: str) -> List[TransferEdge]:
+        return [e for e in self.edges if e.dst_host == host and not e.local]
+
+    def locals_on(self, host: str) -> List[TransferEdge]:
+        return [e for e in self.edges if e.dst_host == host and e.local]
+
+    # -- stats / invariants --
+
+    def bytes_moved(self) -> int:
+        return sum(e.nbytes for e in self.edges if not e.local)
+
+    def bytes_local(self) -> int:
+        return sum(e.nbytes for e in self.edges if e.local)
+
+    def unique_chunk_bytes(self) -> int:
+        """Bytes published once per distinct chunk (replicated destinations
+        share chunks)."""
+        seen = {}
+        for e in self.edges:
+            if not e.local:
+                seen[e.chunk_key()] = e.nbytes
+        return sum(seen.values())
+
+    def fanout(self) -> int:
+        """Max destinations any single published chunk feeds."""
+        counts: Dict[str, int] = {}
+        for e in self.edges:
+            if not e.local:
+                counts[e.chunk_key()] = counts.get(e.chunk_key(), 0) + 1
+        return max(counts.values(), default=0)
+
+    def max_host_leaf_bytes(self, leaf: str) -> int:
+        """The most bytes of ``leaf`` any single host holds at any point of
+        the exchange: its resident source shards plus everything it
+        receives. The no-gather property is
+        ``max_host_leaf_bytes(leaf) < leaf_nbytes`` (unless a side
+        legitimately replicates the leaf)."""
+        import numpy as np
+
+        shape, dtype = (self.src.meta.get(leaf) or self.dst.meta[leaf])
+        item = np.dtype(dtype).itemsize
+        held: Dict[str, int] = {}
+        for host in set(self.src.mesh.hosts) | set(self.dst.mesh.hosts):
+            total = 0
+            if host in self.src.mesh.hosts:
+                boxes = host_boxes(self.src.mesh, self.src.part_of(leaf),
+                                   shape, host)
+                total += sum(box_nbytes(b, item) for b in boxes)
+            total += sum(e.nbytes for e in self.edges
+                         if e.leaf == leaf and e.dst_host == host
+                         and not e.local)
+            held[host] = total
+        return max(held.values(), default=0)
+
+    def no_gather(self) -> bool:
+        """True iff no host ever holds a full copy of any leaf that neither
+        side declares replicated (a replicated side holds full copies by
+        declaration — that is a broadcast, not a gather)."""
+        import numpy as np
+
+        for leaf, (shape, dtype) in self.dst.meta.items():
+            full = box_nbytes(tuple((0, s) for s in shape),
+                              np.dtype(dtype).itemsize)
+            src_rep = all(a is None for a in self.src.part_of(leaf))
+            dst_rep = all(a is None for a in self.dst.part_of(leaf))
+            if src_rep or dst_rep:
+                continue
+            if self.max_host_leaf_bytes(leaf) >= full:
+                return False
+        return True
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "num_edges": len(self.edges),
+            "num_local_edges": sum(1 for e in self.edges if e.local),
+            "bytes_moved": self.bytes_moved(),
+            "bytes_local": self.bytes_local(),
+            "unique_chunk_bytes": self.unique_chunk_bytes(),
+            "fanout": self.fanout(),
+            "num_leaves": len(self.dst.meta),
+            "src_hosts": len(self.src.mesh.hosts),
+            "dst_hosts": len(self.dst.mesh.hosts),
+        }
+
+
+def plan_reshard(src: ShardedTreeSpec, dst: ShardedTreeSpec) -> TransferPlan:
+    """Compute the shard-exchange plan from ``src`` to ``dst``.
+
+    Guarantees, by construction:
+
+    - every destination shard's bytes arrive exactly once (the source boxes
+      are a disjoint grid, so intersections tile each destination box);
+    - total moved bytes <= sum of unique destination shard bytes;
+    - no edge carries bytes its destination already holds (those become
+      ``local`` edges).
+    """
+    import numpy as np
+
+    if set(src.meta) != set(dst.meta):
+        missing = set(src.meta) ^ set(dst.meta)
+        raise ValueError(f"src/dst trees differ on leaves: {sorted(missing)}")
+    import zlib
+
+    plan = TransferPlan(src=src, dst=dst)
+    for leaf in sorted(dst.meta):
+        shape, dtype = dst.meta[leaf]
+        if src.meta[leaf][0] != shape:
+            raise ValueError(
+                f"leaf {leaf!r} shape mismatch: src {src.meta[leaf][0]} vs "
+                f"dst {shape}")
+        item = np.dtype(dtype).itemsize
+        src_grid = unique_boxes(src.mesh, src.part_of(leaf), shape)
+        dst_grid = unique_boxes(dst.mesh, dst.part_of(leaf), shape)
+        for dbox in sorted(dst_grid):
+            for sbox in sorted(src_grid):
+                inter = intersect_box(dbox, sbox)
+                if inter is None:
+                    continue
+                nbytes = box_nbytes(inter, item)
+                replicas = src_grid[sbox]
+                for dhost in dst_grid[dbox]:
+                    if dhost in replicas:
+                        plan.edges.append(TransferEdge(
+                            leaf=leaf, src_host=dhost, dst_host=dhost,
+                            box=inter, src_box=sbox, dst_box=dbox,
+                            nbytes=nbytes, local=True))
+                        continue
+                    flat = f"{leaf}|{inter}".encode()
+                    shost = replicas[zlib.crc32(flat) % len(replicas)]
+                    plan.edges.append(TransferEdge(
+                        leaf=leaf, src_host=shost, dst_host=dhost,
+                        box=inter, src_box=sbox, dst_box=dbox,
+                        nbytes=nbytes, local=False))
+    return plan

@@ -1,0 +1,425 @@
+"""Versioned WeightStore: a named handle for sharded weight hand-off.
+
+Reference: the reference ships weights learner->workers through
+``ray.put`` + polling named actors (rllib) or NCCL broadcast groups; here
+the hand-off is a first-class, versioned control point:
+
+- ``WeightStoreActor`` is a named (GCS-registered), detached actor holding
+  per-version chunk manifests. A chunk is one planner box of one leaf.
+- Publishers either ship chunk BYTES to the actor, which re-``put``s them so
+  the refs are owned by the store and survive publisher death
+  (``durable=True`` — the elastic re-form path), or ``put`` chunks
+  themselves and register only refs (``durable=False`` — zero extra copy;
+  the learner-broadcast fast path, valid while the publisher lives).
+- Consumers ``pull(version)`` a full tree (broadcast) or
+  ``pull_shards(version, dst_spec, host)`` — only the chunks intersecting
+  their destination boxes cross the wire, never a gathered array.
+- ``subscribe()`` long-polls the actor for commits, giving N consumers a
+  push-shaped broadcast without busy polling.
+
+Version monotonicity: versions are ints; ``commit`` refuses to move
+``latest`` backwards, and subscriptions only ever surface strictly newer
+versions. Per-version transfer stats (bytes published/pulled, edges,
+fan-out) are mirrored to the GCS KV ``weights`` namespace for the
+dashboard's ``/api/weights``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.weights.spec import (
+    Box,
+    MeshSpec,
+    ShardedTreeSpec,
+    box_slices,
+    flatten_tree,
+    unflatten_tree,
+)
+
+_STORE_PREFIX = "rtpu_weight_store:"
+_KEEP_VERSIONS = 2  # committed versions retained (older chunks freed)
+
+
+def _encode_box(box: Box) -> str:
+    return ",".join(f"{a}:{b}" for a, b in box)
+
+
+def _decode_box(s: str) -> Box:
+    if not s:
+        return ()
+    return tuple(tuple(int(x) for x in part.split(":")) for part in s.split(","))
+
+
+def _chunk_key(leaf: str, box: Box) -> str:
+    return f"{leaf}|{_encode_box(box)}"
+
+
+def _split_key(key: str) -> Tuple[str, Box]:
+    leaf, _, flat = key.rpartition("|")
+    return leaf, _decode_box(flat)
+
+
+def _spec_payload(spec: ShardedTreeSpec) -> dict:
+    return {
+        "mesh": {"shape": list(spec.mesh.shape),
+                 "axis_names": list(spec.mesh.axis_names),
+                 "hosts": list(spec.mesh.hosts)},
+        "parts": {k: list(v) for k, v in spec.parts.items()},
+        "meta": {k: [list(shape), dtype] for k, (shape, dtype) in
+                 spec.meta.items()},
+    }
+
+
+def _spec_from_payload(d: dict) -> ShardedTreeSpec:
+    m = d["mesh"]
+    return ShardedTreeSpec(
+        mesh=MeshSpec(tuple(m["shape"]), tuple(m["axis_names"]),
+                      tuple(m["hosts"])),
+        parts={k: tuple(v) for k, v in d["parts"].items()},
+        meta={k: (tuple(v[0]), v[1]) for k, v in d["meta"].items()},
+    )
+
+
+class WeightStoreActor:
+    """Named actor holding versioned chunk manifests (sync methods run on
+    executor threads, so object-plane calls are safe; only ``poll`` is
+    async and costs no thread while parked)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._versions: Dict[int, dict] = {}
+        self._latest = -1
+        self._counter = 0
+
+    # -- publish side --------------------------------------------------
+
+    def next_version(self) -> int:
+        self._counter = max(self._counter, self._latest) + 1
+        return self._counter
+
+    def begin(self, version: int, skeleton: Any, spec_payload: dict,
+              num_chunks: int) -> bool:
+        """Open ``version`` for publishing. Idempotent across the source
+        hosts (each calls begin with the same deterministic arguments)."""
+        v = self._versions.get(version)
+        if v is None and version <= self._latest:
+            # a KNOWN version may be re-begun (a publisher whose plan gave
+            # it zero chunks can arrive after the commit); an unknown one
+            # below latest is a real monotonicity violation
+            raise ValueError(
+                f"version {version} not monotonic (latest is {self._latest})")
+        if v is None:
+            self._versions[version] = {
+                "skeleton": skeleton, "spec": spec_payload,
+                "num_chunks": int(num_chunks), "chunks": {},
+                "committed": False, "ts": time.time(),
+                "bytes_published": 0, "bytes_pulled": 0, "num_pulls": 0,
+            }
+        return True
+
+    def put_chunks(self, version: int, blobs: Dict[str, Any]) -> int:
+        """Durable path: chunk bytes arrive as args; re-put them so the
+        refs are OWNED by this actor and outlive the publisher."""
+        v = self._versions[version]
+        for key, arr in blobs.items():
+            if key in v["chunks"]:
+                continue
+            arr = np.asarray(arr)
+            v["chunks"][key] = {"ref": ray_tpu.put(arr),
+                                "nbytes": arr.nbytes,
+                                "dtype": arr.dtype.str}
+            v["bytes_published"] += arr.nbytes
+        self._maybe_commit(version)
+        return len(v["chunks"])
+
+    def register_chunks(self, version: int,
+                        refs: Dict[str, List[Any]],
+                        nbytes: Dict[str, int],
+                        dtypes: Dict[str, str]) -> int:
+        """Zero-copy path: the publisher ``put`` the chunks; we only hold
+        the refs (valid while the publisher's owner process lives)."""
+        v = self._versions[version]
+        for key, boxed_ref in refs.items():
+            if key in v["chunks"]:
+                continue
+            v["chunks"][key] = {"ref": boxed_ref[0],
+                                "nbytes": int(nbytes[key]),
+                                "dtype": dtypes[key]}
+            v["bytes_published"] += int(nbytes[key])
+        self._maybe_commit(version)
+        return len(v["chunks"])
+
+    def _maybe_commit(self, version: int):
+        v = self._versions[version]
+        if v["committed"] or len(v["chunks"]) < v["num_chunks"]:
+            return
+        v["committed"] = True
+        if version > self._latest:
+            self._latest = version
+        # bound retention: drop chunk refs of superseded versions (the
+        # refcounter frees owned objects once nothing borrows them)
+        committed = sorted(k for k, vv in self._versions.items()
+                           if vv["committed"])
+        for old in committed[:-_KEEP_VERSIONS]:
+            self._versions[old]["chunks"] = {}
+            self._versions[old]["retired"] = True
+        self._push_stats()
+
+    def note_pull(self, version: int, nbytes: int) -> bool:
+        v = self._versions.get(version)
+        if v is not None:
+            v["bytes_pulled"] += int(nbytes)
+            v["num_pulls"] += 1
+        return True
+
+    # -- consume side --------------------------------------------------
+
+    def latest(self) -> int:
+        return self._latest
+
+    def manifest(self, version: Optional[int] = None) -> dict:
+        if version is None:
+            version = self._latest
+        if version < 0 or version not in self._versions:
+            raise KeyError(f"weight store {self.name!r} has no version "
+                           f"{version}")
+        v = self._versions[version]
+        if not v["committed"]:
+            raise KeyError(f"version {version} is not committed yet")
+        if v.get("retired"):
+            raise KeyError(f"version {version} was retired "
+                           f"(keep={_KEEP_VERSIONS})")
+        return {
+            "version": version,
+            "skeleton": v["skeleton"],
+            "spec": v["spec"],
+            "chunks": {k: {"ref": [c["ref"]], "nbytes": c["nbytes"],
+                           "dtype": c["dtype"]}
+                       for k, c in v["chunks"].items()},
+        }
+
+    async def poll(self, after_version: int, timeout: float = 25.0) -> int:
+        """Long-poll: resolves with ``latest`` once it exceeds
+        ``after_version`` (or on timeout, with the current latest)."""
+        import asyncio
+
+        deadline = time.monotonic() + timeout
+        while self._latest <= after_version and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        return self._latest
+
+    def stats(self) -> dict:
+        return {
+            "name": self.name,
+            "latest": self._latest,
+            "versions": {
+                str(ver): {k: v[k] for k in
+                           ("committed", "ts", "num_chunks",
+                            "bytes_published", "bytes_pulled", "num_pulls")}
+                for ver, v in sorted(self._versions.items())
+            },
+        }
+
+    def _push_stats(self):
+        """Mirror stats into the GCS KV (``weights`` ns) for the dashboard.
+        Best-effort: stats must never fail a publish."""
+        try:
+            from ray_tpu._private import wire
+            from ray_tpu.experimental.internal_kv import _internal_kv_put
+
+            _internal_kv_put(self.name.encode(), wire.dumps(self.stats()),
+                             namespace="weights")
+        except Exception:  # raylint: disable=EXC001 stats mirror is best-effort by contract
+            pass
+
+
+class WeightSubscription:
+    """Consumer-side cursor over a store's committed versions."""
+
+    def __init__(self, store: "WeightStore", start_after: int = -1):
+        self._store = store
+        self.last_version = start_after
+
+    def poll(self, timeout: float = 0.0):
+        """Return ``(version, tree)`` for the newest committed version
+        strictly after the last one seen, or None. ``timeout`` > 0 long-polls
+        on the store actor (costing no thread there)."""
+        latest = self._store.poll_latest(self.last_version, timeout=timeout)
+        if latest <= self.last_version:
+            return None
+        tree, version = self._store.pull(return_version=True)
+        if version <= self.last_version:
+            return None
+        self.last_version = version
+        return version, tree
+
+    def poll_shards(self, dst_spec: ShardedTreeSpec, host: str,
+                    timeout: float = 0.0):
+        """Sharded flavor: returns ``(version, {leaf: {box: array}})``."""
+        latest = self._store.poll_latest(self.last_version, timeout=timeout)
+        if latest <= self.last_version:
+            return None
+        shards, version = self._store.pull_shards(
+            dst_spec, host, return_version=True)
+        if version <= self.last_version:
+            return None
+        self.last_version = version
+        return version, shards
+
+
+class WeightStore:
+    """Process-local handle on a named weight store (create-or-attach)."""
+
+    def __init__(self, name: str, create: bool = True):
+        self.name = name
+        actor_name = _STORE_PREFIX + name
+        if create:
+            actor_cls = ray_tpu.remote(WeightStoreActor)
+            self._actor = actor_cls.options(
+                name=actor_name, lifetime="detached", get_if_exists=True,
+                max_concurrency=32, num_cpus=0.1).remote(name)
+        else:
+            self._actor = ray_tpu.get_actor(actor_name)
+
+    # -- publish -------------------------------------------------------
+
+    def next_version(self) -> int:
+        return ray_tpu.get(self._actor.next_version.remote(), timeout=60)
+
+    def publish(self, tree: Any, *, version: Optional[int] = None,
+                spec: Optional[ShardedTreeSpec] = None,
+                durable: bool = False, timeout: float = 300.0) -> int:
+        """Publish a FULL tree from this process (the single-source case:
+        a learner broadcasting to env-runners, a driver seeding replicas).
+        For mesh-sharded publishers use :func:`publish_host_shards`."""
+        skeleton, leaves = flatten_tree(tree)
+        arrays = {p: np.asarray(v) for p, v in leaves.items()}
+        if spec is None:
+            spec = ShardedTreeSpec.from_tree(tree, MeshSpec.host_mesh(["src"]))
+        if version is None:
+            version = self.next_version()
+        chunks = {_chunk_key(p, tuple((0, s) for s in a.shape)): a
+                  for p, a in arrays.items()}
+        self._publish_chunks(version, skeleton, spec, chunks,
+                             num_chunks=len(chunks), durable=durable,
+                             timeout=timeout)
+        return version
+
+    def _publish_chunks(self, version: int, skeleton: Any,
+                        spec: ShardedTreeSpec, chunks: Dict[str, np.ndarray],
+                        num_chunks: int, durable: bool, timeout: float):
+        ray_tpu.get(self._actor.begin.remote(
+            version, skeleton, _spec_payload(spec), num_chunks),
+            timeout=timeout)
+        if durable:
+            # ship bytes; the store re-puts so refs survive this process
+            ray_tpu.get(self._actor.put_chunks.remote(version, chunks),
+                        timeout=timeout)
+        else:
+            refs = {k: [ray_tpu.put(a)] for k, a in chunks.items()}
+            nbytes = {k: int(a.nbytes) for k, a in chunks.items()}
+            dtypes = {k: a.dtype.str for k, a in chunks.items()}
+            ray_tpu.get(self._actor.register_chunks.remote(
+                version, refs, nbytes, dtypes), timeout=timeout)
+
+    # -- consume -------------------------------------------------------
+
+    def latest(self) -> int:
+        return ray_tpu.get(self._actor.latest.remote(), timeout=60)
+
+    def poll_latest(self, after_version: int, timeout: float = 0.0) -> int:
+        if timeout <= 0:
+            return self.latest()
+        return ray_tpu.get(
+            self._actor.poll.remote(after_version, timeout),
+            timeout=timeout + 30)
+
+    def manifest(self, version: Optional[int] = None) -> dict:
+        return ray_tpu.get(self._actor.manifest.remote(version), timeout=120)
+
+    def pull(self, version: Optional[int] = None, *,
+             return_version: bool = False, timeout: float = 300.0):
+        """Assemble the FULL tree of ``version`` (default: latest). Only
+        for replicated consumers — sharded consumers use
+        :meth:`pull_shards` and never hold a gathered array."""
+        man = self.manifest(version)
+        leaves: Dict[str, np.ndarray] = {}
+        spec = _spec_from_payload(man["spec"])
+        pulled = 0
+        by_leaf: Dict[str, List[Tuple[Box, dict]]] = {}
+        for key, c in man["chunks"].items():
+            leaf, box = _split_key(key)
+            by_leaf.setdefault(leaf, []).append((box, c))
+        for leaf, (shape, dtype) in spec.meta.items():
+            out = np.empty(shape, dtype=np.dtype(dtype))
+            for box, c in by_leaf.get(leaf, ()):
+                val = np.asarray(ray_tpu.get(c["ref"][0], timeout=timeout))
+                out[box_slices(box)] = val.reshape(
+                    tuple(b - a for a, b in box))
+                pulled += c["nbytes"]
+            leaves[leaf] = out
+        self._actor.note_pull.remote(man["version"], pulled)
+        tree = unflatten_tree(man["skeleton"], leaves)
+        return (tree, man["version"]) if return_version else tree
+
+    def pull_shards(self, dst_spec: ShardedTreeSpec, host: str,
+                    version: Optional[int] = None, *,
+                    return_version: bool = False, timeout: float = 300.0):
+        """Pull exactly this host's destination shards, assembling each from
+        the intersecting published chunks. Returns
+        ``{leaf: {dst_box: array}}``; never materializes a full leaf unless
+        the destination box IS the full leaf."""
+        from ray_tpu.weights.spec import (host_boxes, intersect_box,
+                                          rel_slices)
+
+        man = self.manifest(version)
+        spec = _spec_from_payload(man["spec"])
+        by_leaf: Dict[str, List[Tuple[Box, dict]]] = {}
+        for key, c in man["chunks"].items():
+            leaf, box = _split_key(key)
+            by_leaf.setdefault(leaf, []).append((box, c))
+        out: Dict[str, Dict[Box, np.ndarray]] = {}
+        pulled = 0
+        cache: Dict[str, np.ndarray] = {}
+        for leaf, (shape, dtype) in dst_spec.meta.items():
+            dt = np.dtype(dtype)
+            out[leaf] = {}
+            for dbox in host_boxes(dst_spec.mesh, dst_spec.part_of(leaf),
+                                   shape, host):
+                shard = np.empty(tuple(b - a for a, b in dbox), dtype=dt)
+                for cbox, c in by_leaf.get(leaf, ()):
+                    inter = intersect_box(dbox, cbox)
+                    if inter is None:
+                        continue
+                    key = _chunk_key(leaf, cbox)
+                    chunk = cache.get(key)
+                    if chunk is None:
+                        chunk = np.asarray(
+                            ray_tpu.get(c["ref"][0], timeout=timeout)
+                        ).reshape(tuple(b - a for a, b in cbox))
+                        cache[key] = chunk
+                        pulled += c["nbytes"]
+                    shard[rel_slices(inter, dbox)] = chunk[
+                        rel_slices(inter, cbox)]
+                out[leaf][dbox] = shard
+        self._actor.note_pull.remote(man["version"], pulled)
+        return (out, man["version"]) if return_version else out
+
+    def subscribe(self, start_after: Optional[int] = None
+                  ) -> WeightSubscription:
+        return WeightSubscription(
+            self, self.latest() if start_after is None else start_after)
+
+    def stats(self) -> dict:
+        return ray_tpu.get(self._actor.stats.remote(), timeout=60)
+
+    def shutdown(self):
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:
+            pass

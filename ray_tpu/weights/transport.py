@@ -1,0 +1,213 @@
+"""Transport tier: lower a TransferPlan onto real data movement.
+
+Three tiers, picked by topology (mirrors the collective backends —
+SURVEY.md's CPU/ICI split):
+
+- **Object plane** (:func:`publish_host_shards` / ``WeightStore.pull_shards``):
+  the general cross-mesh path. Each source host cuts exactly the plan's
+  intersection chunks out of its resident shards and publishes them through
+  the store; destination hosts pull only the chunks overlapping their boxes.
+  Owner-tracked refs ride the normal object plane (chunked, spillable,
+  location-directed) — no host ever sees a gathered array.
+
+- **Collective tier** (:func:`collective_reshard`): when src and dst are the
+  SAME mesh (same hosts), edges lower to p2p over the group's eager tier
+  (``collective/collective_group.py`` send/recv — store-rendezvous on CPU,
+  device-resident pulls on the XLA tier) and the store is never touched.
+
+- **XLA tier** (:func:`jax_reshard`): single-controller over live jax
+  devices — resharding is one ``jax.device_put`` to the new
+  ``NamedSharding``; XLA emits the ICI collective exchange (the
+  "portable collective communication" lowering of PAPERS.md). Used by
+  in-process mesh owners (e.g. an engine swapping to a new layout).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.weights.plan import TransferPlan, plan_reshard
+from ray_tpu.weights.spec import (
+    Box,
+    ShardedTreeSpec,
+    box_slices,
+    flatten_tree,
+    host_boxes,
+    rel_slices,
+    unflatten_tree,
+    unique_boxes,
+)
+from ray_tpu.weights.store import WeightStore, _chunk_key
+
+
+def local_shards_of(tree: Any, spec: ShardedTreeSpec, host: str
+                    ) -> Dict[str, Dict[Box, np.ndarray]]:
+    """Cut ``host``'s resident shards out of a locally-held full tree.
+    Test/bootstrap convenience — in SPMD deployments each host already holds
+    only its shards and passes them directly."""
+    _, leaves = flatten_tree(tree)
+    out: Dict[str, Dict[Box, np.ndarray]] = {}
+    for leaf, value in leaves.items():
+        arr = np.asarray(value)
+        shape, _ = spec.meta[leaf]
+        out[leaf] = {box: arr[box_slices(box)]
+                     for box in host_boxes(spec.mesh, spec.part_of(leaf),
+                                           arr.shape, host)}
+    return out
+
+
+def _cut(chunk_box: Box, src_box: Box, shard: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(shard[rel_slices(chunk_box, src_box)])
+
+
+def publish_host_shards(store: WeightStore, version: int,
+                        spec: ShardedTreeSpec, host: str,
+                        shards: Dict[str, Dict[Box, np.ndarray]],
+                        *, skeleton: Any = None,
+                        dst_spec: Optional[ShardedTreeSpec] = None,
+                        durable: bool = False,
+                        timeout: float = 300.0) -> int:
+    """One source host's side of a mesh publish.
+
+    Every host of ``spec.mesh`` calls this with the same ``version``; the
+    store commits once all expected chunks arrive. With ``dst_spec`` the
+    plan's exact intersection chunks are published (minimal bytes for a
+    known destination); without it, the host's unique shard boxes are
+    published as-is (subscriber-agnostic; consumers slice on pull).
+
+    Returns the number of chunks this host contributed.
+    """
+    if skeleton is None:
+        skeleton = {leaf: leaf for leaf in sorted(spec.meta)}
+    if dst_spec is not None:
+        plan = plan_reshard(spec, dst_spec)
+        mine: Dict[str, np.ndarray] = {}
+        for e in plan.sends_from(host):
+            key = _chunk_key(e.leaf, e.box)
+            if key in mine:
+                continue
+            mine[key] = _cut(e.box, e.src_box, shards[e.leaf][e.src_box])
+        # chunk count must be identical on every publisher: the full set of
+        # distinct non-local chunk keys, plus local-only chunks a dst host
+        # already holds (those never cross, so they are NOT published;
+        # consumers satisfy them from their own shards)
+        expected = len({e.chunk_key() for e in plan.edges if not e.local})
+    else:
+        mine = {}
+        for leaf, boxes in shards.items():
+            grid = unique_boxes(spec.mesh, spec.part_of(leaf),
+                                spec.meta[leaf][0])
+            for box, arr in boxes.items():
+                # first replica holder publishes; others stand down
+                if grid.get(box, (host,))[0] != host:
+                    continue
+                mine[_chunk_key(leaf, box)] = np.ascontiguousarray(arr)
+        expected = sum(len(unique_boxes(spec.mesh, spec.part_of(leaf),
+                                        spec.meta[leaf][0]))
+                       for leaf in spec.meta)
+    store._publish_chunks(version, skeleton, spec, mine,
+                          num_chunks=expected, durable=durable,
+                          timeout=timeout)
+    return len(mine)
+
+
+def pull_with_locals(store: WeightStore, version: Optional[int],
+                     src_spec: ShardedTreeSpec, dst_spec: ShardedTreeSpec,
+                     host: str,
+                     local: Dict[str, Dict[Box, np.ndarray]],
+                     timeout: float = 300.0
+                     ) -> Dict[str, Dict[Box, np.ndarray]]:
+    """Destination-side assembly when this host is ALSO a source host (a
+    same-cluster reshard): plan-local chunks are copied from ``local``
+    shards, only the rest is pulled from the store."""
+    plan = plan_reshard(src_spec, dst_spec)
+    pulled = store.pull_shards(dst_spec, host, version, timeout=timeout)
+    for e in plan.locals_on(host):
+        shard = pulled[e.leaf][e.dst_box]
+        shard[rel_slices(e.box, e.dst_box)] = \
+            local[e.leaf][e.src_box][rel_slices(e.box, e.src_box)]
+    return pulled
+
+
+# ---------------------------------------------------------------------------
+# Collective tier: same-mesh reshard without touching the store
+# ---------------------------------------------------------------------------
+
+
+def collective_reshard(plan: TransferPlan, group, host: str,
+                       shards: Dict[str, Dict[Box, np.ndarray]],
+                       ) -> Dict[str, Dict[Box, np.ndarray]]:
+    """Execute ``plan`` over an initialized collective group whose rank i is
+    host i of BOTH meshes (src and dst hosts must coincide — the
+    same-mesh/live-reshard case). Edges lower to the group's eager p2p tier;
+    on the XLA backend the payload stays device-resident at the sender until
+    the receiver pulls it (no store, no driver relay).
+
+    Deterministic pairing: edges are processed in plan order with the edge
+    index as the p2p tag; every host posts all its sends, then drains its
+    recvs — the CPU store tier parks receivers without spinning, the XLA
+    tier leaves tensors parked in the sender's device store.
+    """
+    src_hosts = plan.src.mesh.hosts
+    if tuple(plan.dst.mesh.hosts) != tuple(src_hosts):
+        raise ValueError(
+            "collective_reshard needs identical src/dst host sets; use the "
+            "object-plane transport for cross-mesh moves")
+    rank_of = {h: i for i, h in enumerate(src_hosts)}
+    me = rank_of[host]
+    for tag, e in enumerate(plan.edges):
+        if e.local or rank_of[e.src_host] != me:
+            continue
+        chunk = _cut(e.box, e.src_box, shards[e.leaf][e.src_box])
+        group.send(chunk, rank_of[e.dst_host], tag=tag)
+    out: Dict[str, Dict[Box, np.ndarray]] = {}
+    for leaf, (shape, dtype) in plan.dst.meta.items():
+        out[leaf] = {
+            dbox: np.empty(tuple(b - a for a, b in dbox),
+                           dtype=np.dtype(dtype))
+            for dbox in host_boxes(plan.dst.mesh, plan.dst.part_of(leaf),
+                                   shape, host)}
+    for tag, e in enumerate(plan.edges):
+        if e.dst_host != host:
+            continue
+        dst = out[e.leaf][e.dst_box]
+        if e.local:
+            dst[rel_slices(e.box, e.dst_box)] = \
+                shards[e.leaf][e.src_box][rel_slices(e.box, e.src_box)]
+        else:
+            chunk = np.asarray(group.recv(rank_of[e.src_host], tag=tag))
+            dst[rel_slices(e.box, e.dst_box)] = chunk.reshape(
+                tuple(b - a for a, b in e.box))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# XLA tier: in-process device reshard
+# ---------------------------------------------------------------------------
+
+
+def jax_reshard(tree: Any, mesh_axes: Dict[str, int],
+                parts: Dict[str, Tuple[Optional[str], ...]],
+                default_part: Tuple[Optional[str], ...] = ()) -> Any:
+    """Reshard a pytree onto the live local device mesh via one
+    ``jax.device_put`` per leaf — XLA plans the collective exchange
+    (the ICI lowering; on the CPU test tier this runs over the 8-device
+    virtual mesh). ``mesh_axes`` is name->size over ``jax.devices()``."""
+    from ray_tpu.utils import import_jax
+
+    jax = import_jax()
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    names = tuple(mesh_axes)
+    shape = tuple(mesh_axes[n] for n in names)
+    devices = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    mesh = Mesh(devices, names)
+    skeleton, leaves = flatten_tree(tree)
+    out = {}
+    for path, leaf in leaves.items():
+        part = parts.get(path, default_part)
+        pspec = PartitionSpec(*part) if part else PartitionSpec()
+        out[path] = jax.device_put(leaf, NamedSharding(mesh, pspec))
+    return unflatten_tree(skeleton, out)

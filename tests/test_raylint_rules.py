@@ -227,6 +227,104 @@ def test_exc001_negative_logged_or_narrow_or_offplane():
 
 
 # ---------------------------------------------------------------------------
+# TRC001 — JAX tracers escaping into actor/object state
+# ---------------------------------------------------------------------------
+
+
+def test_trc001_self_store_in_jit_decorated():
+    findings = lint("""
+        import jax
+
+        class Learner:
+            @jax.jit
+            def step(self, params, batch):
+                grads = jax.grad(loss)(params, batch)
+                self.last_grads = grads      # tracer -> actor state
+                return grads
+    """, rules=["TRC001"])
+    assert rules_of(findings) == ["TRC001"]
+    assert "self.last_grads" in findings[0].message
+
+
+def test_trc001_partial_jit_and_aliased_import():
+    findings = lint("""
+        from functools import partial
+        from jax import jit as jj
+
+        class M:
+            @partial(jj, static_argnums=0)
+            def fwd(self, x):
+                self.cache = x * 2
+                return x
+    """, rules=["TRC001"])
+    assert rules_of(findings) == ["TRC001"]
+
+
+def test_trc001_remote_and_put_in_jit_target():
+    findings = lint("""
+        import jax
+        import ray_tpu
+
+        def train_step(state, batch, actor):
+            actor.update.remote(state)       # tracer into a task arg
+            ray_tpu.put(batch)               # tracer into the object plane
+            return state
+
+        train_step = jax.jit(train_step, donate_argnums=0)
+    """, rules=["TRC001"])
+    assert rules_of(findings) == ["TRC001", "TRC001"]
+    assert ".remote" in findings[0].message
+    assert "object plane" in findings[1].message
+
+
+def test_trc001_method_handed_to_jit_via_attribute():
+    findings = lint("""
+        import jax
+
+        class Engine:
+            def __init__(self):
+                self._step = jax.jit(self._step_impl)
+
+            def _step_impl(self, params, toks):
+                self.params = params
+                return toks
+    """, rules=["TRC001"])
+    assert rules_of(findings) == ["TRC001"]
+
+
+def test_trc001_negative_untraced_and_constants():
+    findings = lint("""
+        import jax
+
+        class Learner:
+            def update(self, batch):
+                # sync wrapper OUTSIDE the trace: storing results is fine
+                self.metrics = self._jitted(batch)
+                self.ready = True
+
+            @jax.jit
+            def _jitted(self, batch):
+                self.flag = True             # plain constant: not a tracer
+                local = batch * 2            # locals never escape
+                return local
+    """, rules=["TRC001"])
+    assert rules_of(findings) == []
+
+
+def test_trc001_suppression():
+    findings = lint("""
+        import jax
+
+        class M:
+            @jax.jit
+            def f(self, x):
+                self.x = x  # raylint: disable=TRC001 concrete under disable_jit in tests
+                return x
+    """, rules=["TRC001"])
+    assert rules_of(findings) == []
+
+
+# ---------------------------------------------------------------------------
 # WIRE001 — unregistered wire structs
 # ---------------------------------------------------------------------------
 

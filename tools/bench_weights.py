@@ -1,0 +1,154 @@
+"""Weight-plane microbenchmark: plan stats + transfer throughput on the
+8-device virtual CPU mesh (bench.py-style JSON output).
+
+Measures the three flows the weight plane exists for:
+
+- ``plan``: planner stats for a 4-host train mesh -> 2-host serve mesh
+  reshard of the payload tree (edges, bytes moved, unique chunk bytes).
+- ``broadcast``: one publisher -> N subscriber actors pulling the same
+  version through the store (fan-out throughput, aggregate MB/s).
+- ``reshard``: 4 source actors publish planned chunks, 2 destination actors
+  pull their resharded shards (end-to-end MB/s for the cross-mesh path).
+
+Usage::
+
+    python tools/bench_weights.py [--payload-mb 8] [--runners 8]
+
+Prints one JSON list of ``{"name": ..., "value": ..., "unit": ...}`` rows
+(the microbenchmark idiom of ``_private/microbenchmark.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _payload_tree(payload_mb: float):
+    n = int(payload_mb * 1024 * 1024 // 4 // 8) * 8  # float32, 8-divisible
+    return {"w": np.arange(n, dtype=np.float32).reshape(8, n // 8)}
+
+
+def main(payload_mb: float = 8.0, runners: int = 8) -> list:
+    import ray_tpu
+    from ray_tpu.weights import (MeshSpec, ShardedTreeSpec, WeightStore,
+                                 local_shards_of, plan_reshard,
+                                 publish_host_shards)
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=max(8, runners))
+    tree = _payload_tree(payload_mb)
+    nbytes = tree["w"].nbytes
+    rows = []
+
+    # -- plan stats: 4-host train mesh -> 2-host serve mesh ---------------
+    src_mesh = MeshSpec((4,), ("data",), tuple(f"t{i}" for i in range(4)))
+    dst_mesh = MeshSpec((2,), ("model",), ("s0", "s1"))
+    src = ShardedTreeSpec.from_tree(tree, src_mesh, default_part=("data",))
+    dst = ShardedTreeSpec.from_tree(tree, dst_mesh,
+                                    parts={"w": (None, "model")})
+    plan = plan_reshard(src, dst)
+    st = plan.stats()
+    rows += [
+        {"name": "plan_edges", "value": st["num_edges"], "unit": "edges"},
+        {"name": "plan_bytes_moved", "value": st["bytes_moved"],
+         "unit": "bytes"},
+        {"name": "plan_unique_chunk_bytes", "value": st["unique_chunk_bytes"],
+         "unit": "bytes"},
+        {"name": "plan_no_gather", "value": int(plan.no_gather()),
+         "unit": "bool"},
+    ]
+
+    # -- broadcast fan-out throughput -------------------------------------
+    @ray_tpu.remote(num_cpus=0.1)
+    class Subscriber:
+        def __init__(self, store_name):
+            self.store = WeightStore(store_name)
+
+        def pull(self, version):
+            tree = self.store.pull(version)
+            return int(tree["w"].nbytes)
+
+    store = WeightStore("bench_broadcast")
+    subs = [Subscriber.remote("bench_broadcast") for _ in range(runners)]
+    version = store.publish(tree)
+    ray_tpu.get([s.pull.remote(version) for s in subs], timeout=300)  # warm
+    t0 = time.perf_counter()
+    moved = sum(ray_tpu.get([s.pull.remote(version) for s in subs],
+                            timeout=300))
+    dt = time.perf_counter() - t0
+    rows += [
+        {"name": "broadcast_fanout", "value": runners, "unit": "consumers"},
+        {"name": "broadcast_MB_s", "value": round(moved / dt / 1e6, 1),
+         "unit": "MB/s"},
+    ]
+    for s in subs:
+        ray_tpu.kill(s)
+
+    # -- cross-mesh reshard throughput ------------------------------------
+    @ray_tpu.remote(num_cpus=0.1)
+    class SrcHost:
+        def __init__(self, store_name, host, src, dst, tree_blob):
+            from ray_tpu._private.serialization import loads_trusted
+
+            self.store = WeightStore(store_name)
+            self.host, self.src, self.dst = host, src, dst
+            self.shards = local_shards_of(loads_trusted(tree_blob),
+                                          src, host)
+
+        def publish(self, version):
+            return publish_host_shards(self.store, version, self.src,
+                                       self.host, self.shards,
+                                       dst_spec=self.dst)
+
+    @ray_tpu.remote(num_cpus=0.1)
+    class DstHost:
+        def __init__(self, store_name, host, dst):
+            self.store = WeightStore(store_name)
+            self.host, self.dst = host, dst
+
+        def pull(self, version):
+            shards = self.store.pull_shards(self.dst, self.host, version)
+            return sum(a.nbytes for boxes in shards.values()
+                       for a in boxes.values())
+
+    import cloudpickle
+
+    blob = cloudpickle.dumps(tree)
+    srcs = [SrcHost.remote("bench_reshard", h, src, dst, blob)
+            for h in src_mesh.hosts]
+    dsts = [DstHost.remote("bench_reshard", h, dst) for h in dst_mesh.hosts]
+    t0 = time.perf_counter()
+    ray_tpu.get([s.publish.remote(1) for s in srcs], timeout=300)
+    moved = sum(ray_tpu.get([d.pull.remote(1) for d in dsts], timeout=300))
+    dt = time.perf_counter() - t0
+    rows += [
+        {"name": "reshard_bytes", "value": moved, "unit": "bytes"},
+        {"name": "reshard_MB_s", "value": round(moved / dt / 1e6, 1),
+         "unit": "MB/s"},
+        {"name": "payload_MB", "value": round(nbytes / 1e6, 1),
+         "unit": "MB"},
+    ]
+    for a in srcs + dsts:
+        ray_tpu.kill(a)
+    return rows
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--payload-mb", type=float, default=8.0)
+    parser.add_argument("--runners", type=int, default=8)
+    args = parser.parse_args()
+    import ray_tpu
+
+    rows = main(args.payload_mb, args.runners)
+    print(json.dumps(rows))
+    ray_tpu.shutdown()
+    sys.exit(0)

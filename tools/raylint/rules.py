@@ -14,6 +14,9 @@ suppression guidance per rule.
 * WIRE001 — a struct defined in a wire-schema module that is not registered
   in the ``wire.py`` registry (it would raise WireError at runtime, or worse,
   tempt someone to pickle it).
+* TRC001 — a JAX tracer escaping into actor/object state: a value stored on
+  ``self`` or shipped through ``.remote()``/``ray_tpu.put()`` from inside a
+  ``jit``/``grad``-traced function.
 """
 
 from __future__ import annotations
@@ -336,6 +339,113 @@ class SwallowedException(Rule):
                 f"swallowed `except {shown}` with no log call; add "
                 f"`logger.debug(...)` with context, or suppress with a reason "
                 f"(`# raylint: disable=EXC001 <why>`)"))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# TRC001 — JAX tracers escaping into actor/object state
+# ---------------------------------------------------------------------------
+
+# Transforms that TRACE their function: inside these bodies every value is a
+# Tracer, and letting one escape the trace is at best an
+# UnexpectedTracerError at the next use, at worst a silently baked-in
+# constant (jit) or a leaked trace-context hold on device buffers.
+_TRACING_TRANSFORMS = {
+    "jax.jit", "jax.grad", "jax.value_and_grad", "jax.vmap", "jax.pmap",
+    "jax.checkpoint", "jax.remat", "jax.custom_jvp", "jax.custom_vjp",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.cond",
+    "jax.experimental.shard_map.shard_map", "shard_map.shard_map",
+}
+
+
+def _jit_target_names(tree: ast.AST, resolver) -> Set[str]:
+    """Names of functions passed to a tracing transform anywhere in the
+    module: ``jax.jit(step)``, ``self._fwd = jax.jit(self._fwd_impl)``,
+    ``train = jit(train_impl, donate_argnums=0)`` ..."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = resolver.dotted(node.func)
+        if dotted not in _TRACING_TRANSFORMS:
+            continue
+        for arg in node.args[:1]:  # the traced callable is arg 0
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+            elif isinstance(arg, ast.Attribute):
+                names.add(arg.attr)
+    return names
+
+
+def _is_traced_def(node, resolver) -> bool:
+    """Decorated directly (`@jax.jit`), via a call (`@jax.jit`/
+    `@partial(jax.jit, ...)`), or by any tracing transform."""
+    for dec in node.decorator_list:
+        target = dec
+        if isinstance(dec, ast.Call):
+            dotted = resolver.dotted(dec.func) or ""
+            if dotted in ("functools.partial", "partial") and dec.args:
+                target = dec.args[0]
+            else:
+                target = dec.func
+        if (resolver.dotted(target) or "") in _TRACING_TRANSFORMS:
+            return True
+    return False
+
+
+@register_rule
+class TracerEscape(Rule):
+    name = "TRC001"
+    summary = ("JAX tracer escaping into actor/object state: a traced value "
+               "stored on `self` or shipped via `.remote()`/`put()` from a "
+               "jit/grad scope")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        resolver = module.resolver
+        traced_names = _jit_target_names(module.tree, resolver)
+        findings: List[Finding] = []
+
+        def scan_traced_body(fn_node):
+            for node in ast.walk(fn_node):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                                and not isinstance(node.value, ast.Constant)):
+                            findings.append(self.finding(
+                                module, node,
+                                f"`self.{t.attr} = ...` inside a traced "
+                                f"function: the stored value is a Tracer — "
+                                f"it escapes the trace into actor state and "
+                                f"dies with UnexpectedTracerError (or bakes "
+                                f"in a constant); return it from the jitted "
+                                f"function instead"))
+                elif isinstance(node, ast.Call):
+                    dotted = resolver.dotted(node.func)
+                    if dotted in ("ray_tpu.put", "ray.put"):
+                        findings.append(self.finding(
+                            module, node,
+                            f"`{dotted}(...)` inside a traced function "
+                            f"ships a Tracer into the object plane; move "
+                            f"the put outside the jit/grad scope"))
+                    elif (isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "remote"):
+                        findings.append(self.finding(
+                            module, node,
+                            "`.remote(...)` inside a traced function: task "
+                            "args would be Tracers (and the submission "
+                            "itself is a traced side effect that jit will "
+                            "elide on cache hits); submit outside the "
+                            "traced scope"))
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if _is_traced_def(node, resolver) or node.name in traced_names:
+                scan_traced_body(node)
         return iter(findings)
 
 
