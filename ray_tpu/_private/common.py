@@ -141,6 +141,12 @@ class TaskSpec:
     is_actor_creation: bool = False
     actor_options: Optional[ActorOptions] = None
     attempt: int = 0
+    # trace-context propagation (reference: TaskSpec's serialized OTel
+    # context in tracing_helper.py): the submitting side stamps the caller's
+    # active span so execution-side spans and nested submissions form one
+    # cross-process trace tree. Empty when tracing is disabled.
+    trace_id: str = ""
+    parent_span_id: str = ""
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]

@@ -135,6 +135,13 @@ _d("borrower_death_timeout_s", 120.0)
 _d("borrow_debounce_s", 0.25)  # skip borrow RPCs for transient handles
 _d("max_object_reconstructions", 5)
 
+# --- observability (task events + metrics; reference: task_event_buffer.cc
+# report interval + gcs_task_manager.cc per-job caps) ---
+_d("task_events_flush_interval_s", 1.0)
+_d("metrics_flush_interval_s", 10.0)
+_d("gcs_task_events_max_per_job", 4096)  # per-job ring; drop-oldest beyond
+_d("task_events_max_per_task", 64)  # transition entries kept per task
+
 # --- train / libs ---
 _d("train_health_check_period_s", 1.0)
 _d("serve_proxy_port", 8000)

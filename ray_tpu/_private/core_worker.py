@@ -32,6 +32,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import task_events
+from ray_tpu._private.async_util import spawn
 from ray_tpu._private.common import ActorOptions, TaskOptions, TaskSpec
 from ray_tpu._private.config import RAY_CONFIG
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
@@ -57,6 +59,44 @@ from ray_tpu.object_ref import ObjectRef
 logger = logging.getLogger("ray_tpu.worker")
 
 _LEASE_IDLE_S = 2.0
+
+# cluster-unique metrics key tag (pids collide across nodes/restarts)
+_obs_proc_tag = uuid.uuid4().hex[:10]
+
+_LATENCY_BOUNDS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0]
+
+_obs_instruments = None
+
+
+def _obs():
+    """Built-in always-on instruments (reference: the core worker's
+    ray_task_* opencensus metrics in metric_defs.cc), created lazily so
+    merely importing this module registers nothing."""
+    global _obs_instruments
+    if _obs_instruments is None:
+        from ray_tpu.util.metrics import Gauge, Histogram
+
+        _obs_instruments = {
+            "e2e": Histogram(
+                "ray_tpu_task_e2e_seconds",
+                "end-to-end task latency: submit to completion at the owner",
+                boundaries=_LATENCY_BOUNDS, tag_keys=("function",)),
+            "exec": Histogram(
+                "ray_tpu_task_exec_seconds",
+                "task execution latency on the worker",
+                boundaries=_LATENCY_BOUNDS, tag_keys=("function",)),
+            "loop_lag": Gauge(
+                "ray_tpu_event_loop_lag_seconds",
+                "io event-loop scheduling delay (sleep-drift sampled)"),
+        }
+    return _obs_instruments
+
+
+def _task_span_id(spec: TaskSpec) -> str:
+    """Deterministic execution-span id: the submitter never learns it, yet
+    children submitted DURING execution and the span recorded AFTER it must
+    agree on the id (retries get distinct spans per attempt)."""
+    return f"{spec.task_id.hex()[:12]}a{spec.attempt}"
 
 
 def _freeze(d: Dict[str, float]) -> tuple:
@@ -120,7 +160,7 @@ class _LeasePool:
         want = min(max(1, len(self.pending)), cap)
         while self.pushers - self.busy < want:
             self.pushers += 1
-            asyncio.ensure_future(self._pusher())
+            spawn(self._pusher(), what="lease-pool pusher")
 
     async def _pusher(self):
         """Acquire one lease, then drain the queue in batches until idle."""
@@ -220,10 +260,17 @@ class _LeasePool:
         batch = [r for r in batch if not self._drop_if_cancelled(r)]
         if not batch:
             return True
+        events_on = task_events.enabled()
         for record in batch:
             record["epoch"] = record.get("epoch", -1) + 1
             record["spec"].attempt = record["epoch"]
             record["_pushed_to"] = lease["worker_address"]
+            if events_on:
+                task_events.record(
+                    record["spec"].task_id.hex(), task_events.SCHEDULED,
+                    attempt=record["epoch"],
+                    worker=lease["worker_address"],
+                    job_id=record.get("_job_hex", ""))
         payload = wire.dumps({"specs": [r["spec"] for r in batch]})
         try:
             reply = wire.loads(await core._worker_client(
@@ -246,6 +293,10 @@ class _LeasePool:
                 else:
                     logger.warning("retrying task %s (attempt %d): %s",
                                    record["name"], record["attempts"], e)
+                    task_events.record(
+                        record["spec"].task_id.hex(), task_events.RETRYING,
+                        attempt=record["attempts"], error=f"worker died: {e}",
+                        job_id=record.get("_job_hex", ""))
                     self._reset_stream_for_retry(record)
                     self.pending.append(record)
             if exhausted:
@@ -276,6 +327,10 @@ class _LeasePool:
                         and not isinstance(err, TaskCancelledError) \
                         and record["attempts"] < record["max_retries"]:
                     record["attempts"] += 1
+                    task_events.record(
+                        record["spec"].task_id.hex(), task_events.RETRYING,
+                        attempt=record["attempts"], error=str(err),
+                        job_id=record.get("_job_hex", ""))
                     self._reset_stream_for_retry(record)
                     self.pending.append(record)
                 else:
@@ -673,8 +728,65 @@ class CoreWorker:
             object_ref_mod.set_ref_counter(self.ref_counter)
             # periodic drain of the __del__-safe deletion queue (refs dropped
             # while the process is otherwise idle must still free)
-            asyncio.run_coroutine_threadsafe(self._refcount_sweep(), self.loop)
+            self._sweep_fut = asyncio.run_coroutine_threadsafe(
+                self._refcount_sweep(), self.loop)
+        # always-on observability: task-event flush + periodic metrics
+        # publish + loop-lag sampling (reference: the core worker's
+        # task_event_buffer flush timer + metrics agent push)
+        self._obs_fut = asyncio.run_coroutine_threadsafe(
+            self._obs_flush_loop(), self.loop)
         return self
+
+    async def _obs_flush_loop(self):
+        """Ship buffered task lifecycle events every
+        ``task_events_flush_interval_s`` and auto-publish this process's
+        metrics registry every ``metrics_flush_interval_s`` (replacing the
+        manual ``publish_metrics()``). The sleep's drift doubles as the
+        event-loop lag sample."""
+        interval = RAY_CONFIG.task_events_flush_interval_s
+        metrics_every = RAY_CONFIG.metrics_flush_interval_s
+        last_metrics = 0.0
+        while not self._shutdown:
+            before = time.monotonic()
+            await asyncio.sleep(interval)
+            lag = max(0.0, time.monotonic() - before - interval)
+            try:
+                _obs()["loop_lag"].set(lag)
+                events, dropped = task_events.drain()
+                if events or dropped:
+                    try:
+                        await self._gcs_call("AddTaskEvents", {
+                            "events": events, "dropped": dropped})
+                    except (RpcError, asyncio.TimeoutError, OSError) as e:
+                        task_events.rebuffer(events, dropped)
+                        logger.debug("task-event flush failed "
+                                     "(will retry): %s", e)
+                now = time.monotonic()
+                if now - last_metrics >= metrics_every:
+                    last_metrics = now
+                    await self._publish_metrics()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.debug("observability flush iteration failed",
+                             exc_info=True)
+
+    async def _publish_metrics(self):
+        """Push this process's metrics registry to the GCS KV (metrics
+        namespace); the dashboard's /metrics aggregates all processes."""
+        from ray_tpu.util.metrics import scrape_metrics
+
+        snap = scrape_metrics()
+        if not snap:
+            return
+        payload = {"pid": os.getpid(), "time": time.time(),
+                   "node": self.node_hex, "metrics": snap}
+        try:
+            await self._gcs_call("KVPut", {
+                "ns": "metrics", "key": f"proc_{_obs_proc_tag}",
+                "value": wire.dumps(payload)})
+        except (RpcError, asyncio.TimeoutError, OSError) as e:
+            logger.debug("metrics publish failed (will retry): %s", e)
 
     async def _refcount_sweep(self):
         last_reassert = time.monotonic()
@@ -685,7 +797,7 @@ class CoreWorker:
                     last_reassert = time.monotonic()
                     # fire-and-track: an unreachable owner (10s timeout
                     # each) must not stall the 0.2s flush cadence
-                    asyncio.ensure_future(self._reassert_borrows())
+                    spawn(self._reassert_borrows(), what="borrow re-assert")
             except Exception:
                 logger.exception("refcount sweep failed")
             await asyncio.sleep(0.2)
@@ -1223,7 +1335,7 @@ class CoreWorker:
 
         def _fire():
             if not self._shutdown:
-                asyncio.ensure_future(self._free_owned(oid))
+                spawn(self._free_owned(oid), what="owned-object free")
 
         # grace delay absorbs in-flight AddBorrower registrations
         self.loop.call_later(RAY_CONFIG.free_grace_s, _fire)
@@ -1284,7 +1396,8 @@ class CoreWorker:
         def _later():
             self.loop.call_later(
                 RAY_CONFIG.borrow_debounce_s,
-                lambda: asyncio.ensure_future(self._register_borrow(oid, owner)))
+                lambda: spawn(self._register_borrow(oid, owner),
+                              what="borrow registration"))
 
         try:
             self.loop.call_soon_threadsafe(_later)
@@ -1324,7 +1437,7 @@ class CoreWorker:
         watch[oid] = watch.get(oid, 0) + 1  # new registration generation
         if addr not in self._borrow_watch_active:
             self._borrow_watch_active.add(addr)
-            asyncio.ensure_future(self._borrow_watch_loop(addr))
+            spawn(self._borrow_watch_loop(addr), what="borrow watch loop")
 
     async def _borrow_watch_loop(self, addr: str):
         """One long-poll loop per borrower address covering all its borrowed
@@ -1376,7 +1489,7 @@ class CoreWorker:
                 # respawn covers exceptions / adds that raced the exit;
                 # re-assert the existing generation rather than minting one
                 self._borrow_watch_active.add(addr)
-                asyncio.ensure_future(self._borrow_watch_loop(addr))
+                spawn(self._borrow_watch_loop(addr), what="borrow watch loop")
 
     def _register_lineage(self, task_id: TaskID, record: dict):
         """Retain the task record for reconstruction while its outputs are
@@ -1444,14 +1557,15 @@ class CoreWorker:
                 self.ref_counter.add_borrower(oid, executor_addr)
                 self._watch_borrower(oid, executor_addr)
             else:
-                asyncio.ensure_future(self._forward_borrow(owner, oid, executor_addr))
+                spawn(self._forward_borrow(owner, oid, executor_addr),
+                      what="borrow forward")
         nested = reply.get("nested") or {}
         for ret_oid, inner in nested.items():
             self.ref_counter.pin_nested(ret_oid, list(inner))
             for oid, owner in inner:
                 if owner and owner != self.address:
-                    asyncio.ensure_future(
-                        self._forward_borrow(owner, oid, self.address))
+                    spawn(self._forward_borrow(owner, oid, self.address),
+                          what="borrow forward")
 
     async def _forward_borrow(self, owner: str, oid: bytes, borrower: str):
         try:
@@ -1525,7 +1639,13 @@ class CoreWorker:
         record = {"spec": spec, "attempts": 0, "max_retries": max_retries,
                   "return_ids": [ref.id for ref in refs],
                   "arg_refs": arg_refs, "bytes": len(args_blob) + 512,
-                  "name": remote_fn.function_name}
+                  "name": remote_fn.function_name,
+                  "_submit_ts": time.time()}
+        self._stamp_trace(spec, record["name"])
+        if task_events.enabled():
+            record["_job_hex"] = jh = self.job_id.hex()
+            task_events.record(task_id.hex(), task_events.SUBMITTED,
+                               name=record["name"], job_id=jh)
         for oid, owner in arg_refs:
             self.ref_counter.pin(oid, owner)
         record["_pinned"] = True
@@ -1540,7 +1660,8 @@ class CoreWorker:
 
         def _kickoff():
             self._register_lineage(task_id, record)
-            asyncio.ensure_future(self._drive_task_prepared(remote_fn, record))
+            spawn(self._drive_task_prepared(remote_fn, record),
+                  what="task drive")
 
         self._queue_kickoff(_kickoff)
         if streaming:
@@ -1548,6 +1669,28 @@ class CoreWorker:
 
             return ObjectRefGenerator(self, task_id, self.address)
         return refs[0] if nret == 1 else refs
+
+    def _stamp_trace(self, spec: TaskSpec, name: str):
+        """Propagate the caller's trace context into the spec (reference:
+        tracing_helper.py injecting the OTel context into the TaskSpec).
+        Records a zero-width ``submit`` span as the flow-arrow anchor: the
+        driver (or enclosing task) side of the driver→worker edge. No-op
+        unless tracing is enabled."""
+        from ray_tpu.util import tracing
+
+        if not tracing.enabled():
+            return
+        ctx = tracing.current_context()
+        trace_id = ctx[0] if ctx is not None else tracing.new_trace_id()
+        span_id = tracing.new_span_id()
+        now = time.time()
+        tracing.record_span(
+            f"submit:{name}", now, now, category="submit",
+            trace_id=trace_id, span_id=span_id,
+            parent_id=ctx[1] if ctx is not None else None,
+            task_id=spec.task_id.hex())
+        spec.trace_id = trace_id
+        spec.parent_span_id = span_id
 
     async def _drive_task_prepared(self, remote_fn, record: dict):
         """Resolve the (cached) function key + runtime env, then drive."""
@@ -1611,14 +1754,40 @@ class CoreWorker:
 
             self._complete_error(record, TaskCancelledError())
             return
+        if task_events.enabled():
+            task_events.record(spec.task_id.hex(), task_events.LEASE_REQUESTED,
+                               attempt=spec.attempt,
+                               job_id=record.get("_job_hex", ""))
         pool = self._lease_pool_for(opts, opts.required_resources())
         record["_done"] = asyncio.Event()
         pool.submit(record)
         if wait:
             await record["_done"].wait()
 
+    def _observe_complete(self, record, err: Optional[TaskError]):
+        """Terminal lifecycle event + end-to-end latency histogram (the
+        always-on half of observability: costs one histogram observe and,
+        when task events are on, a buffered append)."""
+        submit_ts = record.get("_submit_ts")
+        if submit_ts is not None and record.get("name"):
+            try:
+                _obs()["e2e"].observe(time.time() - submit_ts,
+                                      tags={"function": record["name"]})
+            except Exception:  # raylint: disable=EXC001 metrics must never fail a task completion
+                pass
+        if task_events.enabled():
+            spec = record["spec"]
+            task_events.record(
+                spec.task_id.hex(),
+                task_events.FAILED if err is not None else task_events.FINISHED,
+                attempt=max(record.get("attempts", 0),
+                            record.get("epoch", 0) or 0),
+                error=str(err) if err is not None else "",
+                job_id=record.get("_job_hex", ""))
+
     def _complete_ok(self, record, results, stream_count=None):
         record["_completed"] = True
+        self._observe_complete(record, None)
         if record["spec"].num_returns == -1:
             st = self._streams.get(record["spec"].task_id.binary())
             if st is not None:
@@ -1645,6 +1814,7 @@ class CoreWorker:
 
     def _complete_error(self, record, err: TaskError):
         record["_completed"] = True
+        self._observe_complete(record, err)
         streaming = record["spec"].num_returns == -1
         if streaming:
             st = self._streams.get(record["spec"].task_id.binary())
@@ -1890,7 +2060,13 @@ class CoreWorker:
                   "max_retries": handle._max_task_retries,
                   "return_ids": [ref.id for ref in refs],
                   "arg_refs": arg_refs,
-                  "name": f"{handle._class_name}.{method_name}"}
+                  "name": f"{handle._class_name}.{method_name}",
+                  "_submit_ts": time.time()}
+        self._stamp_trace(spec, record["name"])
+        if task_events.enabled():
+            record["_job_hex"] = jh = self.job_id.hex()
+            task_events.record(task_id.hex(), task_events.SUBMITTED,
+                               name=record["name"], job_id=jh)
         for oid, owner in arg_refs:
             self.ref_counter.pin(oid, owner)
         record["_pinned"] = True
@@ -1900,7 +2076,7 @@ class CoreWorker:
         def _kickoff():
             view = self._actor_view(handle.actor_id)
             self._actor_inflight[task_id] = record
-            asyncio.ensure_future(self._drive_actor_task(view, record))
+            spawn(self._drive_actor_task(view, record), what="actor-task drive")
 
         self._queue_kickoff(_kickoff)
         if streaming:
@@ -1957,6 +2133,11 @@ class CoreWorker:
                 record["epoch"] = record.get("epoch", -1) + 1
                 spec.attempt = record["epoch"]
                 record["_pushed_to"] = view.address
+                if task_events.enabled():
+                    task_events.record(
+                        spec.task_id.hex(), task_events.SCHEDULED,
+                        attempt=record["epoch"], worker=view.address,
+                        job_id=record.get("_job_hex", ""))
                 # short connect timeout + one blind reconnect: the address came
                 # from an ALIVE view, so an unreachable peer means the view is
                 # stale — fail fast into the GCS recheck below (the real retry
@@ -1980,6 +2161,10 @@ class CoreWorker:
                         f"ActorUnavailableError: {record['name']} failed: {e}", "",
                         ActorUnavailableError(str(e))))
                     return
+                task_events.record(
+                    spec.task_id.hex(), task_events.RETRYING,
+                    attempt=record["attempts"], error=f"actor push failed: {e}",
+                    job_id=record.get("_job_hex", ""))
                 continue
             if reply["status"] == "ok":
                 self._process_reply_refs(reply, view.address)
@@ -2608,11 +2793,13 @@ class CoreWorker:
                 return None, TaskCancelledError(
                     "TaskCancelledError: cancelled before execution", "")
             self._running_tasks[tid_b] = threading.get_ident()
+            token = self._obs_task_start(spec)
             try:
                 return fn(*args, **kwargs), None
             except Exception as e:
                 return None, TaskError(repr(e), traceback.format_exc())
             finally:
+                self._obs_task_end(token)
                 self._running_tasks.pop(tid_b, None)
 
         gen, err = await self.loop.run_in_executor(self._exec_pool, _start)
@@ -2627,6 +2814,7 @@ class CoreWorker:
                     self._cancelled_pending.discard(tid_b)
                     return None, True, TaskCancelledError()
                 self._running_tasks[tid_b] = threading.get_ident()
+                token = self._install_trace(spec)
                 try:
                     return next(gen), False, None
                 except StopIteration:
@@ -2637,6 +2825,7 @@ class CoreWorker:
                     return None, True, TaskError(repr(e),
                                                  traceback.format_exc())
                 finally:
+                    self._obs_task_end(token)
                     self._running_tasks.pop(tid_b, None)
             value, done, err = await self.loop.run_in_executor(
                 self._exec_pool, _step)
@@ -2703,6 +2892,7 @@ class CoreWorker:
 
         if inspect.isasyncgenfunction(method):
             async with self._actor_sem:
+                obs_token = self._obs_task_start(spec)
                 try:
                     agen = method(*args, **kwargs)
                     async for value in agen:
@@ -2717,10 +2907,13 @@ class CoreWorker:
                     err = e
                 except Exception as e:
                     err = TaskError(repr(e), traceback.format_exc())
+                finally:
+                    self._obs_task_end(obs_token)
         else:
             self._ensure_pool(1)
 
             def _start():
+                token = self._obs_task_start(spec)
                 try:
                     out = method(*args, **kwargs)
                     if not hasattr(out, "__next__"):
@@ -2731,6 +2924,8 @@ class CoreWorker:
                     return out, None
                 except Exception as e:
                     return None, TaskError(repr(e), traceback.format_exc())
+                finally:
+                    self._obs_task_end(token)
 
             gen, err = await self.loop.run_in_executor(self._exec_pool, _start)
             while err is None:
@@ -2738,6 +2933,7 @@ class CoreWorker:
                     if tid_b in self._cancelled_pending:
                         self._cancelled_pending.discard(tid_b)
                         return None, True, TaskCancelledError()
+                    token = self._install_trace(spec)
                     try:
                         return next(gen), False, None
                     except StopIteration:
@@ -2745,6 +2941,8 @@ class CoreWorker:
                     except Exception as e:
                         return None, True, TaskError(repr(e),
                                                      traceback.format_exc())
+                    finally:
+                        self._obs_task_end(token)
 
                 value, done, err = await self.loop.run_in_executor(
                     self._exec_pool, _step)
@@ -2763,20 +2961,58 @@ class CoreWorker:
         reply["stream_count"] = index
         return wire.dumps(reply)
 
+    def _install_trace(self, spec: TaskSpec):
+        """Install this task's span as the active trace context (so nested
+        ``.remote()`` calls and ``tracing.profile()`` blocks parent onto
+        it); returns a reset token, or None when tracing is off."""
+        from ray_tpu.util import tracing
+
+        if not tracing.enabled() or not spec.trace_id:
+            return None
+        return tracing.set_context(spec.trace_id, _task_span_id(spec))
+
+    def _obs_task_start(self, spec: TaskSpec):
+        """Execution-start observability: a RUNNING lifecycle event plus
+        trace-context install. Returns the trace token for _obs_task_end."""
+        if task_events.enabled():
+            task_events.record(
+                spec.task_id.hex(), task_events.RUNNING,
+                attempt=spec.attempt, job_id=spec.job_id.hex(),
+                worker=self.address, node=self.node_hex)
+        return self._install_trace(spec)
+
+    def _obs_task_end(self, token):
+        if token is not None:
+            from ray_tpu.util import tracing
+
+            tracing.reset_context(token)
+
     def _trace_task(self, spec: TaskSpec, name: str, t0: float, err,
                     t1: Optional[float] = None):
-        """Span per executed task (reference: profile_event.cc into the
-        task event buffer); no-op unless tracing is enabled."""
+        """Per-executed-task exec-latency metric (always on) + trace span
+        (reference: profile_event.cc into the task event buffer); the span
+        carries the task's causal ids so export_chrome_trace can draw the
+        submit→execute flow arrow."""
+        end = t1 if t1 is not None else time.time()
+        if spec.actor_id is not None and spec.method_name:
+            name = f"{type(self.actor_instance).__name__}.{spec.method_name}"                 if self.actor_instance is not None else spec.method_name
+        try:
+            _obs()["exec"].observe(end - t0, tags={"function": name})
+        except Exception:  # raylint: disable=EXC001 metrics must never fail task execution
+            pass
         from ray_tpu.util import tracing
 
         if not tracing.enabled():
             return
-        if spec.actor_id is not None and spec.method_name:
-            name = f"{type(self.actor_instance).__name__}.{spec.method_name}"                 if self.actor_instance is not None else spec.method_name
+        extra = {}
+        if spec.trace_id:
+            extra = {"trace_id": spec.trace_id,
+                     "span_id": _task_span_id(spec),
+                     "parent_id": spec.parent_span_id or None}
         tracing.record_span(
-            name, t0, t1 if t1 is not None else time.time(),
+            name, t0, end,
             category="actor_task" if spec.actor_id is not None else "task",
-            task_id=spec.task_id.hex(), ok=err is None)
+            task_id=spec.task_id.hex(), ok=err is None, **extra)
 
     def _call_user_fn(self, fn, args, kwargs, spec: TaskSpec):
         from ray_tpu.exceptions import TaskCancelledError
@@ -2788,6 +3024,7 @@ class CoreWorker:
                 "TaskCancelledError: cancelled before execution started", "")
         self._running_tasks[tid_b] = threading.get_ident()
         self._tls.task_id = spec.task_id
+        obs_token = self._obs_task_start(spec)
         try:
             result = fn(*args, **kwargs)
             if asyncio.iscoroutine(result):
@@ -2812,6 +3049,7 @@ class CoreWorker:
         except Exception as e:
             return None, TaskError(repr(e), traceback.format_exc())
         finally:
+            self._obs_task_end(obs_token)
             self._running_tasks.pop(tid_b, None)
             self._tls.task_id = None
 
@@ -2958,6 +3196,16 @@ class CoreWorker:
         if nxt is not None:
             nxt.set()
 
+    async def _run_actor_coro(self, method, args, kwargs, spec: TaskSpec):
+        """Async actor method under this task's observability context: the
+        RUNNING event and trace install happen inside the child task, so the
+        contextvar scope dies with it and never leaks onto the loop."""
+        token = self._obs_task_start(spec)
+        try:
+            return await method(*args, **kwargs)
+        finally:
+            self._obs_task_end(token)
+
     async def _exec_actor_task(self, spec: TaskSpec) -> bytes:
         if self.actor_instance is None:
             err = TaskError("ActorUnavailableError: actor instance not initialized", "")
@@ -3010,7 +3258,8 @@ class CoreWorker:
                     # run as a child task so CancelTask can .cancel() it
                     # without touching this RPC handler (reference:
                     # async-actor cooperative cancellation)
-                    atask = asyncio.ensure_future(method(*args, **kwargs))
+                    atask = asyncio.ensure_future(
+                        self._run_actor_coro(method, args, kwargs, spec))
                     self._running_async_tasks[tid_b] = atask
                     try:
                         result, err = await atask, None
@@ -3049,6 +3298,16 @@ class CoreWorker:
                 tracing.flush()
         except Exception as e:
             logger.debug("tracing flush at shutdown failed: %s", e)
+        try:
+            # tail-event protection: events recorded since the last flush
+            # interval must not die with the process
+            task_events.flush()
+        except Exception as e:
+            logger.debug("task-event flush at shutdown failed: %s", e)
+        for fut_name in ("_obs_fut", "_sweep_fut"):
+            fut = getattr(self, fut_name, None)
+            if fut is not None:
+                fut.cancel()
 
         async def _close():
             if self.server:

@@ -35,6 +35,8 @@ from ray_tpu._private.common import (
     resources_ge,
 )
 from ray_tpu._private.config import RAY_CONFIG
+from ray_tpu._private.async_util import spawn
+from ray_tpu._private.task_events import TERMINAL_STATES
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.rpc import RpcError, RpcServer, RetryingRpcClient, ServerConnection
 from ray_tpu._private.store_client import make_store
@@ -130,6 +132,135 @@ class PGRecord:
         return pg
 
 
+class GcsTaskManager:
+    """Bounded per-job store of task lifecycle events.
+
+    Reference: ``gcs/gcs_server/gcs_task_manager.cc`` — core workers flush
+    batched state transitions here; the store keeps a bounded per-job ring
+    (drop-oldest + a drop counter so truncation is visible, mirroring
+    ``RAY_task_events_max_num_task_in_gcs``), merges owner-side and
+    executor-side events by task id, and serves ``ray list tasks`` /
+    ``ray summary tasks`` / the dashboard timeline."""
+
+    def __init__(self, max_per_job: Optional[int] = None,
+                 max_events_per_task: Optional[int] = None):
+        self.max_per_job = max_per_job or RAY_CONFIG.gcs_task_events_max_per_job
+        self.max_events_per_task = (max_events_per_task
+                                    or RAY_CONFIG.task_events_max_per_task)
+        # job_hex -> {task_id_hex: record}, insertion-ordered (dict) so the
+        # oldest task evicts first when the ring is full
+        self.jobs: Dict[str, Dict[str, dict]] = {}
+        # flat id index: owner and executor flush independently (the
+        # executor's RUNNING may even arrive first), and the lookup runs
+        # once per event — it must be O(1), not a scan over every ring
+        self._by_tid: Dict[str, dict] = {}
+        self.dropped: Dict[str, int] = {}  # per-job: ring evictions +
+        #                                    reporter-side buffer drops
+
+    def add_events(self, events: List[dict], dropped: int = 0):
+        for ev in events:
+            tid = ev.get("task_id")
+            if not tid:
+                continue
+            rec = self._by_tid.get(tid)
+            if rec is None:
+                job = ev.get("job_id") or "unknown"
+                ring = self.jobs.setdefault(job, {})
+                while len(ring) >= self.max_per_job:
+                    oldest = next(iter(ring))
+                    del ring[oldest]
+                    self._by_tid.pop(oldest, None)
+                    self.dropped[job] = self.dropped.get(job, 0) + 1
+                rec = ring[tid] = self._by_tid[tid] = {
+                    "task_id": tid, "job_id": job, "name": "", "state": "",
+                    "attempt": 0, "error": "", "worker": "", "node": "",
+                    "events": [], "_last_ts": 0.0,
+                }
+            self._merge(rec, ev)
+        if dropped:
+            self.dropped["_reporter"] = self.dropped.get("_reporter", 0) + dropped
+
+    def _find(self, tid: str) -> Optional[dict]:
+        return self._by_tid.get(tid)
+
+    def _merge(self, rec: dict, ev: dict):
+        entry = {"state": ev["state"], "ts": ev["ts"],
+                 "attempt": ev.get("attempt", 0)}
+        if ev.get("error"):
+            entry["error"] = ev["error"]
+        events = rec["events"]
+        events.append(entry)
+        if len(events) > self.max_events_per_task:
+            del events[: len(events) - self.max_events_per_task]
+        if ev.get("name"):
+            rec["name"] = ev["name"]
+        if ev.get("worker"):
+            rec["worker"] = ev["worker"]
+        if ev.get("node"):
+            rec["node"] = ev["node"]
+        if ev.get("error"):
+            rec["error"] = ev["error"]
+        rec["attempt"] = max(rec["attempt"], ev.get("attempt", 0))
+        # latest-state resolution: owner and executor flush independently,
+        # so events can arrive out of ts order; a terminal state is never
+        # overridden by a late RUNNING
+        if ev["state"] in TERMINAL_STATES or (
+                rec["state"] not in TERMINAL_STATES
+                and ev["ts"] >= rec["_last_ts"]):
+            rec["state"] = ev["state"]
+        rec["_last_ts"] = max(rec["_last_ts"], ev["ts"])
+
+    @staticmethod
+    def _dump(rec: dict) -> dict:
+        events = sorted(rec["events"], key=lambda e: e["ts"])
+        out = {k: v for k, v in rec.items() if not k.startswith("_")}
+        out["events"] = events
+        if events:
+            out["start_ts"] = events[0]["ts"]
+            out["end_ts"] = events[-1]["ts"]
+            out["duration_s"] = events[-1]["ts"] - events[0]["ts"]
+        return out
+
+    def list_tasks(self, job_id: Optional[str] = None,
+                   name: Optional[str] = None, state: Optional[str] = None,
+                   limit: int = 200) -> List[dict]:
+        out = []
+        for job, ring in self.jobs.items():
+            if job_id and job != job_id:
+                continue
+            for rec in ring.values():
+                # substring match: function names are qualnames
+                # ("mod.<locals>.fn"), exact equality would be unusable
+                if name and name not in rec["name"]:
+                    continue
+                if state and rec["state"] != state:
+                    continue
+                out.append(self._dump(rec))
+        out.sort(key=lambda r: r.get("start_ts", 0.0))
+        return out[-int(limit):]
+
+    def get_task(self, tid: str) -> Optional[dict]:
+        rec = self._find(tid)
+        return self._dump(rec) if rec is not None else None
+
+    def summarize(self, job_id: Optional[str] = None) -> dict:
+        """Per-function counts by lifecycle state (the ``ray summary
+        tasks`` analog)."""
+        per_fn: Dict[str, Dict[str, int]] = {}
+        total = 0
+        for job, ring in self.jobs.items():
+            if job_id and job != job_id:
+                continue
+            for rec in ring.values():
+                total += 1
+                fn = rec["name"] or "<unknown>"
+                by_state = per_fn.setdefault(fn, {})
+                st = rec["state"] or "UNKNOWN"
+                by_state[st] = by_state.get(st, 0) + 1
+        return {"per_function": per_fn, "total": total,
+                "dropped": dict(self.dropped)}
+
+
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, persist_dir: str = ""):
         self.store = make_store(persist_dir)
@@ -165,6 +296,8 @@ class GcsServer:
         # structured event ring (reference: util/event.cc + export events
         # aggregated by the dashboard) — bounded, newest at the right
         self.events = deque(maxlen=1000)
+        # task lifecycle events (reference: gcs_task_manager.cc)
+        self.task_manager = GcsTaskManager()
         self._background: List[asyncio.Task] = []
         self.start_time = time.time()
         self._load_init_data()
@@ -251,15 +384,17 @@ class GcsServer:
                 if record.address:
                     # a creation was in flight when we died: probe before
                     # rescheduling so we never run two instances
-                    asyncio.ensure_future(self._recover_creating_actor(record))
+                    spawn(self._recover_creating_actor(record),
+                          what="actor creation recovery")
                 else:
-                    asyncio.ensure_future(self._schedule_actor(record))
+                    spawn(self._schedule_actor(record), what="actor scheduling")
         for job_id, job in list(self.jobs.items()):
             if job["state"] == "RUNNING":
-                asyncio.ensure_future(self._reap_job_if_driver_gone(job_id, job))
+                spawn(self._reap_job_if_driver_gone(job_id, job),
+                      what="job reap probe")
         for pg in self.pgs.values():
             if pg.state in ("PENDING", "RESCHEDULING"):
-                asyncio.ensure_future(self._schedule_pg(pg))
+                spawn(self._schedule_pg(pg), what="placement-group scheduling")
         logger.info("GCS listening on %s", addr)
         return addr
 
@@ -285,7 +420,7 @@ class GcsServer:
         payload = wire.dumps(message)
         for conn, channels in list(self.subs.values()):
             if channel in channels:
-                asyncio.ensure_future(conn.push(channel, payload))
+                spawn(conn.push(channel, payload), what="pubsub push")
 
     async def _on_disconnect(self, conn: ServerConnection):
         self.subs.pop(conn.conn_id, None)
@@ -405,7 +540,7 @@ class GcsServer:
         for pg in self.pgs.values():
             if pg.state == "CREATED" and any(n == node_id for n in pg.bundle_nodes):
                 pg.state = "RESCHEDULING"
-                asyncio.ensure_future(self._schedule_pg(pg))
+                spawn(self._schedule_pg(pg), what="placement-group scheduling")
 
     # ------------------------------------------------------------------
     # kv
@@ -523,6 +658,24 @@ class GcsServer:
             out = [e for e in out if e.get("severity") == want]
         return {"events": out[-int(req.get("limit") or 200):]}
 
+    # -- task lifecycle events (reference: gcs_task_manager.cc RPCs) --
+
+    async def _rpc_AddTaskEvents(self, req, conn):
+        self.task_manager.add_events(req.get("events") or [],
+                                     int(req.get("dropped") or 0))
+        return {"status": "ok"}
+
+    async def _rpc_ListTasks(self, req, conn):
+        return {"tasks": self.task_manager.list_tasks(
+            job_id=req.get("job_id"), name=req.get("name"),
+            state=req.get("state"), limit=int(req.get("limit") or 200))}
+
+    async def _rpc_GetTask(self, req, conn):
+        return {"task": self.task_manager.get_task(req["task_id"])}
+
+    async def _rpc_SummarizeTasks(self, req, conn):
+        return self.task_manager.summarize(job_id=req.get("job_id"))
+
     async def _rpc_Subscribe(self, req, conn):
         channels = set(req["channels"])
         existing = self.subs.get(conn.conn_id)
@@ -557,14 +710,14 @@ class GcsServer:
                 self.object_dir[oid] = {"attempt": attempt, "nodes": {node_id},
                                         "size": size or entry.get("size", 0)}
                 if displaced:
-                    asyncio.ensure_future(
-                        self._delete_stale_copies(oid, attempt, displaced))
+                    spawn(self._delete_stale_copies(oid, attempt, displaced),
+                          what="stale-copy delete")
             elif attempt == entry["attempt"]:
                 entry["nodes"].add(node_id)
             else:
                 # stale-epoch announce: reject, and tell that node to drop it
-                asyncio.ensure_future(self._delete_stale_copies(
-                    oid, entry["attempt"], {node_id}))
+                spawn(self._delete_stale_copies(
+                    oid, entry["attempt"], {node_id}), what="stale-copy delete")
         return {"status": "ok"}
 
     async def _delete_stale_copies(self, oid: bytes, attempt: int, nodes):
@@ -734,7 +887,7 @@ class GcsServer:
         if record.name:
             self.named_actors[(record.namespace, record.name)] = actor_id
         self._persist_actor(record)
-        asyncio.ensure_future(self._schedule_actor(record))
+        spawn(self._schedule_actor(record), what="actor scheduling")
         return {"status": "ok", "info": record.info()}
 
     async def _schedule_actor(self, record: ActorRecord):
@@ -863,7 +1016,7 @@ class GcsServer:
         record.node_id = None
         record.lease_id = ""
         self._persist_actor(record)
-        asyncio.ensure_future(self._schedule_actor(record))
+        spawn(self._schedule_actor(record), what="actor scheduling")
 
     async def _reap_job_if_driver_gone(self, job_id: JobID, job: dict):
         """Replayed RUNNING jobs lost their connection binding when the GCS
@@ -926,7 +1079,7 @@ class GcsServer:
         record.address = ""
         record.node_id = None
         self._publish_actor(record)
-        asyncio.ensure_future(self._schedule_actor(record))
+        spawn(self._schedule_actor(record), what="actor scheduling")
 
     async def _rpc_GetActorInfo(self, req, conn):
         record = self.actors.get(ActorID(req["actor_id"]))
@@ -1014,7 +1167,7 @@ class GcsServer:
         pg = PGRecord(spec)
         self.pgs[spec.pg_id] = pg
         self._persist_pg(pg)
-        asyncio.ensure_future(self._schedule_pg(pg))
+        spawn(self._schedule_pg(pg), what="placement-group scheduling")
         return {"status": "ok"}
 
     async def _rpc_WaitPlacementGroupReady(self, req, conn):

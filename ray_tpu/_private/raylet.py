@@ -41,6 +41,7 @@ from ray_tpu._private.common import (
     resources_ge,
     resources_sub,
 )
+from ray_tpu._private.async_util import spawn
 from ray_tpu._private.config import RAY_CONFIG
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.object_store import ObjectStoreServer
@@ -149,6 +150,7 @@ class Raylet:
         await self.gcs.call("RegisterNode", wire.dumps({"info": info}))
         await self._subscribe_view()
         self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._background.append(asyncio.ensure_future(self._metrics_loop()))
         self._background.append(asyncio.ensure_future(self._monitor_workers_loop()))
         self._background.append(asyncio.ensure_future(self._memory_monitor_loop()))
         self._background.append(asyncio.ensure_future(self._prestart_workers()))
@@ -326,6 +328,67 @@ class Raylet:
                 logger.debug("heartbeat/re-register to GCS failed "
                              "(will retry): %s", e)
             await asyncio.sleep(period)
+
+    async def _metrics_loop(self):
+        """Always-on raylet runtime metrics (reference: the raylet-side
+        ray_* gauges in metric_defs.cc pushed through the metrics agent):
+        lease-queue depth, object-store occupancy + spill counts, worker
+        pool size, event-loop lag — set here and auto-published to the GCS
+        metrics namespace so the dashboard's /metrics exposes them without
+        any manual publish call."""
+        from ray_tpu.util.metrics import Gauge, scrape_metrics
+
+        gauges = {
+            "lease_queue": Gauge(
+                "ray_tpu_raylet_lease_queue_depth",
+                "granted-lease waiters parked at this raylet"),
+            "parked": Gauge(
+                "ray_tpu_raylet_parked_lease_shapes",
+                "unplaceable lease shapes reported as autoscaler demand"),
+            "leases": Gauge("ray_tpu_raylet_leases_held",
+                            "currently granted worker leases"),
+            "workers": Gauge("ray_tpu_raylet_workers",
+                             "live worker processes on this node"),
+            "store_bytes": Gauge("ray_tpu_object_store_bytes",
+                                 "bytes resident in the local object store"),
+            "store_objects": Gauge("ray_tpu_object_store_objects",
+                                   "objects resident in the local store"),
+            "spilled": Gauge("ray_tpu_object_store_spilled_objects",
+                             "objects spilled to external storage (total)"),
+            "restored": Gauge("ray_tpu_object_store_restored_objects",
+                              "objects restored from external storage (total)"),
+            "loop_lag": Gauge("ray_tpu_raylet_loop_lag_seconds",
+                              "raylet event-loop scheduling delay"),
+        }
+        node_tag = {"node_id": self.node_id.hex()[:16]}
+        for g in gauges.values():
+            g.set_default_tags(node_tag)
+        interval = RAY_CONFIG.metrics_flush_interval_s
+        key = f"raylet_{self.node_id.hex()[:10]}"
+        while True:
+            before = time.monotonic()
+            await asyncio.sleep(interval)
+            lag = max(0.0, time.monotonic() - before - interval)
+            try:
+                gauges["loop_lag"].set(lag)
+                gauges["lease_queue"].set(len(self._lease_waiters))
+                gauges["parked"].set(len(self._parked))
+                gauges["leases"].set(len(self.leases))
+                gauges["workers"].set(len(self.workers))
+                gauges["store_bytes"].set(self.store.used)
+                gauges["store_objects"].set(len(self.store.objects))
+                gauges["spilled"].set(self.store.num_spilled)
+                gauges["restored"].set(self.store.num_restored)
+                payload = {"pid": os.getpid(), "time": time.time(),
+                           "node": self.node_id.hex(),
+                           "metrics": scrape_metrics()}
+                await self.gcs.call("KVPut", wire.dumps({
+                    "ns": "metrics", "key": key,
+                    "value": wire.dumps(payload)}), timeout=10.0, retries=0)
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("raylet metrics publish failed (will retry): %s", e)
+            except Exception:
+                logger.exception("raylet metrics iteration failed")
 
     # ------------------------------------------------------------------
     # worker pool (reference: src/ray/raylet/worker_pool.h:276)
@@ -782,7 +845,7 @@ class Raylet:
         attempt = req.get("attempt", 0)
         if not self.store.seal(req["oid"], attempt):
             return {"status": "stale_attempt"}
-        asyncio.ensure_future(self._announce([req["oid"]], attempt))
+        spawn(self._announce([req["oid"]], attempt), what="object announce")
         return {"status": "ok"}
 
     async def _rpc_StorePutInline(self, req, conn):
@@ -790,7 +853,7 @@ class Raylet:
         if not self.store.put_inline(req["oid"], req["blob"], attempt,
                                      owner=req.get("owner", "")):
             return {"status": "stale_attempt"}
-        asyncio.ensure_future(self._announce([req["oid"]], attempt))
+        spawn(self._announce([req["oid"]], attempt), what="object announce")
         return {"status": "ok"}
 
     async def _rpc_StoreDeleteStale(self, req, conn):
@@ -820,7 +883,7 @@ class Raylet:
             if owner:
                 by_owner.setdefault(owner, []).append(o)
         for owner, group in by_owner.items():
-            asyncio.ensure_future(self._notify_owner(owner, "ObjectLocAnnounce", {
+            spawn(self._notify_owner(owner, "ObjectLocAnnounce", {
                 "oids": group, "node_id": self.node_id.hex(),
                 "address": self.server.address,
                 "sizes": {o: self.store.object_size(o) or 0 for o in group},
@@ -847,7 +910,8 @@ class Raylet:
                 # grace before close: a concurrent notify/query may still
                 # be awaiting on this client
                 asyncio.get_event_loop().call_later(
-                    30.0, lambda c=evicted: asyncio.ensure_future(c.close()))
+                    30.0, lambda c=evicted: spawn(c.close(),
+                                                  what="evicted-client close"))
             client = cache[addr] = RetryingRpcClient(addr)
         else:
             cache.move_to_end(addr)
@@ -896,7 +960,7 @@ class Raylet:
                          len(req["oids"]), e)
         for o, owner in owners.items():
             if owner:  # keep the owner-resident view from going stale
-                asyncio.ensure_future(self._notify_owner(
+                spawn(self._notify_owner(
                     owner, "ObjectLocDrop",
                     {"oid": o, "node_id": self.node_id.hex()}))
         return {"status": "ok"}

@@ -25,6 +25,7 @@ from typing import Awaitable, Callable, Dict, Optional, Tuple
 
 import msgpack
 
+from ray_tpu._private.async_util import spawn
 from ray_tpu._private.config import RAY_CONFIG
 from ray_tpu._private.wire import WIRE_VERSION
 
@@ -204,9 +205,11 @@ class RpcServer:
             while True:
                 msg_id, kind, method, payload = await _read_frame(reader)
                 if kind == _NOTIFY:
-                    asyncio.ensure_future(self._dispatch(conn, None, method, payload))
+                    spawn(self._dispatch(conn, None, method, payload),
+                          what="rpc notify dispatch")
                 elif kind == _REQUEST:
-                    asyncio.ensure_future(self._dispatch(conn, msg_id, method, payload))
+                    spawn(self._dispatch(conn, msg_id, method, payload),
+                          what="rpc request dispatch")
         except RpcVersionError as e:
             logger.warning("dropping %s: %s", conn.peer, e)
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
@@ -308,7 +311,7 @@ class RpcClient:
                         try:
                             res = self._on_push(method, payload)
                             if asyncio.iscoroutine(res):
-                                asyncio.ensure_future(res)
+                                spawn(res, what="push handler")
                         except Exception:
                             logger.exception("push handler failed")
                 elif kind in (_REPLY_OK, _REPLY_ERR):

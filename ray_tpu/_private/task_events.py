@@ -1,0 +1,171 @@
+"""Task lifecycle event pipeline: the per-process event buffer.
+
+Reference: ``src/ray/core_worker/task_event_buffer.cc`` — every core worker
+buffers per-task state transitions (status events + profile events) and
+periodically flushes them in batches to the GCS ``GcsTaskManager``
+(``gcs/gcs_server/gcs_task_manager.cc``), which keeps a bounded per-job
+store powering ``ray summary tasks``, ``ray list tasks`` and the dashboard
+timeline.
+
+Here: both sides of a task record timestamped transitions into this
+module's bounded buffer — the OWNER records SUBMITTED / LEASE_REQUESTED /
+SCHEDULED / RETRYING / FINISHED / FAILED, the EXECUTING worker records
+RUNNING — and the core worker's observability flush loop ships batches to
+the GCS ``AddTaskEvents`` RPC (``_private/gcs.py``), where the
+GcsTaskManager-equivalent merges them per task id. Surfaced via
+``util.state.list_tasks()/get_task()/summarize_tasks()``, the dashboard's
+``/api/tasks``, and the ``ray-tpu tasks`` CLI.
+
+Always on by default (like the reference's task events): recording is a
+lock + list append; set ``RAY_TPU_TASK_EVENTS=0`` to disable entirely.
+The buffer is bounded (drop-oldest + drop counter, mirrored to the GCS so
+truncation is visible, never silent).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+# Lifecycle states, in nominal order (reference: common.proto TaskStatus).
+SUBMITTED = "SUBMITTED"
+LEASE_REQUESTED = "LEASE_REQUESTED"
+SCHEDULED = "SCHEDULED"
+RUNNING = "RUNNING"
+RETRYING = "RETRYING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+TERMINAL_STATES = (FINISHED, FAILED)
+
+_MAX_BUFFER = 10_000  # drop-oldest beyond this: events never leak unbounded
+_ERR_MAX = 200  # error summaries are truncated; full tracebacks stay in logs
+
+_lock = threading.Lock()
+_buffer: "deque[dict]" = deque()
+_dropped = 0
+_enabled: Optional[bool] = None
+
+
+def enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        _enabled = os.environ.get("RAY_TPU_TASK_EVENTS", "1") not in ("0", "false")
+    return _enabled
+
+
+def set_enabled(value: Optional[bool]):
+    """Override the env flag (None = re-read it); used by tests/benchmarks."""
+    global _enabled
+    _enabled = value
+
+
+def record(task_id_hex: str, state: str, *, name: str = "", job_id: str = "",
+           attempt: int = 0, error: str = "", worker: str = "",
+           node: str = "") -> None:
+    """Buffer one state transition. Cheap (lock + append); never raises."""
+    if not enabled():
+        return
+    event: Dict[str, Any] = {"task_id": task_id_hex, "state": state,
+                             "ts": time.time(), "attempt": attempt}
+    if name:
+        event["name"] = name
+    if job_id:
+        event["job_id"] = job_id
+    if error:
+        # summary, not transcript: first line, bounded (full tracebacks
+        # stay in worker logs / the task's error object)
+        event["error"] = error.splitlines()[0][:_ERR_MAX]
+    if worker:
+        event["worker"] = worker
+    if node:
+        event["node"] = node
+    global _dropped
+    with _lock:
+        if len(_buffer) >= _MAX_BUFFER:
+            _buffer.popleft()
+            _dropped += 1
+        _buffer.append(event)
+
+
+def drain() -> Tuple[List[dict], int]:
+    """Take everything buffered (called by the flush loop). Returns
+    (events, dropped_since_last_drain)."""
+    global _dropped
+    with _lock:
+        if not _buffer and not _dropped:
+            return [], 0
+        events, dropped = list(_buffer), _dropped
+        _buffer.clear()
+        _dropped = 0
+    return events, dropped
+
+
+def rebuffer(events: List[dict], dropped: int = 0):
+    """Put events (and the drained drop count) back after a failed flush
+    (oldest-first, still bounded) — a failed ship must not erase the
+    truncation evidence the counter exists to surface."""
+    global _dropped
+    with _lock:
+        _dropped += dropped
+        _buffer.extendleft(reversed(events))
+        while len(_buffer) > _MAX_BUFFER:
+            _buffer.popleft()
+            _dropped += 1
+
+
+def pending() -> int:
+    with _lock:
+        return len(_buffer)
+
+
+def flush():
+    """Synchronously push buffered events to the GCS; safe to call anywhere
+    (worker shutdown, atexit). Mirrors tracing.flush()'s tiering: no-op
+    pre-init and in local mode; from the worker's own event loop it ships
+    fire-and-forget (blocking there would deadlock the loop)."""
+    events, dropped = drain()
+    if not events and not dropped:
+        return
+    try:
+        from ray_tpu._private.worker import global_worker, is_initialized
+
+        if not is_initialized():
+            rebuffer(events, dropped)
+            return
+        core = global_worker()
+        if getattr(core, "mode", "") == "local" or not hasattr(core, "_gcs_call"):
+            return  # local mode: lifecycle is inline; nothing to ship
+        req = {"events": events, "dropped": dropped}
+
+        async def _put_guarded():
+            try:
+                await core._gcs_call("AddTaskEvents", req)
+            except Exception:
+                rebuffer(events, dropped)
+
+        import asyncio
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is not None and running is core.loop:
+            from ray_tpu._private.async_util import spawn
+
+            spawn(_put_guarded(), what="task-event flush")
+        else:
+            core._run(_put_guarded())
+    except Exception:
+        # observability must never take down the workload
+        rebuffer(events, dropped)
+
+
+# tail-event protection: transitions recorded in the last flush interval
+# before process exit must not die with the process (tracing.py registers
+# the same hook for spans on first record)
+atexit.register(flush)

@@ -85,6 +85,8 @@ class DashboardHead:
             web.get("/api/pgs", self._pgs),
             web.get("/api/cluster_status", self._cluster_status),
             web.get("/api/summary", self._summary),
+            web.get("/api/tasks", self._tasks),
+            web.get("/api/tasks/summary", self._tasks_summary),
             web.get("/metrics", self._prometheus),
             web.get("/api/nodes/{node_id}/stats", self._node_stats),
             web.get("/api/data_stats", self._data_stats),
@@ -220,6 +222,25 @@ class DashboardHead:
     async def _profile_memory(self, request):
         return await self._profile(request, "memory")
 
+    async def _tasks(self, request):
+        """Task lifecycle records from the GCS task manager (reference:
+        the dashboard's state API /api/v0/tasks). Query params: job_id,
+        name, state, limit."""
+        from aiohttp import web
+
+        q = request.query
+        reply = await self._call("ListTasks", {
+            "job_id": q.get("job_id"), "name": q.get("name"),
+            "state": q.get("state"), "limit": int(q.get("limit", 200))})
+        return web.json_response(reply["tasks"])
+
+    async def _tasks_summary(self, request):
+        """Per-function counts by lifecycle state (`ray summary tasks`)."""
+        from aiohttp import web
+
+        return web.json_response(await self._call(
+            "SummarizeTasks", {"job_id": request.query.get("job_id")}))
+
     async def _pgs(self, request):
         from aiohttp import web
 
@@ -324,19 +345,40 @@ class DashboardHead:
                 continue
             if time.time() - payload.get("time", 0) > 120:
                 continue  # stale process snapshot
+            # pid alone is not unique cluster-wide (two nodes can both have
+            # a pid 1234; duplicate label sets make Prometheus reject the
+            # whole scrape) — disambiguate with the reporting node
+            proc_labels = {"pid": str(payload["pid"])}
+            if payload.get("node"):
+                proc_labels["node"] = str(payload["node"])[:16]
             for name, m in payload.get("metrics", {}).items():
                 prom = name.replace(".", "_").replace("-", "_")
                 if m["kind"] in ("counter", "gauge"):
                     for tag_json, val in m["data"].items():
-                        labels = {**json.loads(tag_json), "pid": str(payload["pid"])}
+                        labels = {**json.loads(tag_json), **proc_labels}
                         emit(prom, val, labels,
                              help_=m.get("description") if prom not in seen_names else None,
                              kind=m["kind"])
                         seen_names.add(prom)
                 elif m["kind"] == "histogram":
-                    for tag_json, s in m["data"].get("sums", {}).items():
-                        labels = {**json.loads(tag_json), "pid": str(payload["pid"])}
-                        emit(prom + "_sum", s, labels)
+                    bounds = m["data"].get("boundaries") or []
+                    first_h = prom not in seen_names
+                    seen_names.add(prom)
+                    if first_h:
+                        lines.append(f"# HELP {prom} {m.get('description', '')}")
+                        lines.append(f"# TYPE {prom} histogram")
+                    for tag_json, counts in m["data"].get("counts", {}).items():
+                        labels = {**json.loads(tag_json), **proc_labels}
+                        cum = 0
+                        for b, c in zip(bounds, counts):
+                            cum += c
+                            emit(prom + "_bucket", cum, {**labels, "le": str(b)})
+                        cum += counts[-1] if len(counts) > len(bounds) else 0
+                        emit(prom + "_bucket", cum, {**labels, "le": "+Inf"})
+                        emit(prom + "_count", cum, labels)
+                        s = m["data"].get("sums", {}).get(tag_json)
+                        if s is not None:
+                            emit(prom + "_sum", s, labels)
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 

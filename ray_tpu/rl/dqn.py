@@ -49,11 +49,12 @@ class _DQNRunner:
     """Vector env sampler emitting (s, a, r, s', done) transitions."""
 
     def __init__(self, config_blob: bytes, worker_index: int):
-        import cloudpickle as _cp
+        from ray_tpu._private.serialization import loads_trusted
 
         from ray_tpu.rl.env_runner import EpisodeTracker, make_vec_env
 
-        self.cfg: DQNConfig = _cp.loads(config_blob)
+        # the blob is authored by the driving Algorithm (trusted producer)
+        self.cfg: DQNConfig = loads_trusted(config_blob)
         self.envs, self.obs = make_vec_env(
             self.cfg.env, self.cfg.num_envs_per_runner,
             self.cfg.seed + worker_index * 1000)

@@ -79,11 +79,12 @@ class _ImpalaRunner:
     """Time-major rollout sampler carrying behavior logp for V-trace."""
 
     def __init__(self, config_blob: bytes, worker_index: int):
-        import cloudpickle as _cp
+        from ray_tpu._private.serialization import loads_trusted
 
         from ray_tpu.rl.env_runner import EpisodeTracker, make_vec_env
 
-        self.cfg: IMPALAConfig = _cp.loads(config_blob)
+        # the blob is authored by the driving Algorithm (trusted producer)
+        self.cfg: IMPALAConfig = loads_trusted(config_blob)
         self.envs, self.obs = make_vec_env(
             self.cfg.env, self.cfg.num_envs_per_runner,
             self.cfg.seed + worker_index * 1000)

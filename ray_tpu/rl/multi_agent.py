@@ -139,9 +139,10 @@ class _MultiAgentRunner:
     (reference: multi_agent_env_runner.py)."""
 
     def __init__(self, config_blob: bytes, worker_index: int):
-        import cloudpickle as _cp
+        from ray_tpu._private.serialization import loads_trusted
 
-        self.cfg: MultiAgentPPOConfig = _cp.loads(config_blob)
+        # the blob is authored by the driving Algorithm (trusted producer)
+        self.cfg: MultiAgentPPOConfig = loads_trusted(config_blob)
         ctor = _ENV_REGISTRY[self.cfg.env]
         self.env = ctor(seed=self.cfg.seed + worker_index * 1000,
                         **self.cfg.env_config)

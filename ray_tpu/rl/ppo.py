@@ -50,11 +50,12 @@ class EnvRunner:
     """Vectorized env sampler (reference: env/single_agent_env_runner.py:68)."""
 
     def __init__(self, config_blob: bytes, worker_index: int):
-        import cloudpickle as _cp
+        from ray_tpu._private.serialization import loads_trusted
 
         from ray_tpu.rl.env_runner import EpisodeTracker, make_vec_env
 
-        self.cfg: PPOConfig = _cp.loads(config_blob)
+        # the blob is authored by the driving Algorithm (trusted producer)
+        self.cfg: PPOConfig = loads_trusted(config_blob)
         # same-step autoreset (via make_vec_env): the obs after a done is the
         # next episode's reset obs, so every stored transition is a real one
         self.envs, self.obs = make_vec_env(

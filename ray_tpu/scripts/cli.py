@@ -144,6 +144,33 @@ def cmd_events(args):
               f"{e.get('message')} {meta}")
 
 
+def cmd_tasks(args):
+    """ray-tpu tasks: task lifecycle records and the `ray summary tasks`
+    analog (reference: `ray list tasks` / `ray summary tasks` backed by
+    GcsTaskManager)."""
+    _connect(args)
+    import time as _t
+
+    from ray_tpu.util import state
+
+    if args.summary:
+        print(json.dumps(state.summarize_tasks(), indent=2))
+        return
+    if args.task_id:
+        print(json.dumps(state.get_task(args.task_id), indent=2, default=str))
+        return
+    tasks = state.list_tasks(name=args.name or None,
+                             state_filter=args.state or None,
+                             limit=args.limit)
+    for t in tasks:
+        start = _t.strftime("%H:%M:%S",
+                            _t.localtime(t.get("start_ts", 0)))
+        transitions = "->".join(e["state"] for e in t.get("events", []))
+        err = f" err={t['error']!r}" if t.get("error") else ""
+        print(f"{start} {t['task_id'][:16]} {t['name'] or '?':32} "
+              f"[{t['state']}] attempt={t['attempt']} {transitions}{err}")
+
+
 def cmd_microbenchmark(args):
     import ray_tpu
 
@@ -229,6 +256,15 @@ def main(argv=None):
     p.add_argument("--severity", default="")
     p.add_argument("--limit", type=int, default=100)
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("tasks", help="task lifecycle records / summary")
+    p.add_argument("--summary", action="store_true",
+                   help="per-function counts by state (ray summary tasks)")
+    p.add_argument("--task-id", default="", help="one task's full record")
+    p.add_argument("--name", default="", help="filter by function name")
+    p.add_argument("--state", default="", help="filter by lifecycle state")
+    p.add_argument("--limit", type=int, default=100)
+    p.set_defaults(fn=cmd_tasks)
 
     p = sub.add_parser("microbenchmark", help="run the core perf suite")
     p.add_argument("--duration", type=float, default=2.0)

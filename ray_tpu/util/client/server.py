@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 import ray_tpu
+from ray_tpu._private.async_util import spawn
 from ray_tpu._private.rpc import RpcServer
 from ray_tpu._private.serialization import loads_trusted
 
@@ -80,7 +81,7 @@ class ClientProxyServer:
             return
         sess.conn_ids.discard(conn.conn_id)
         if not sess.conn_ids:
-            asyncio.ensure_future(self._reap_after_grace(session_id))
+            spawn(self._reap_after_grace(session_id), what="client-session reap")
 
     async def _reap_after_grace(self, session_id: str):
         await asyncio.sleep(_REAP_GRACE_S)

@@ -46,9 +46,9 @@ def record(source: str, severity: str, message: str, **metadata) -> None:
             return
         coro = core._gcs_call("ReportEvent", {"event": event})
         if _on_worker_loop(core):
-            import asyncio
+            from ray_tpu._private.async_util import spawn
 
-            asyncio.ensure_future(coro)
+            spawn(coro, what="event report")
         else:
             core._run(coro, 10.0)
     except Exception:

@@ -1,8 +1,17 @@
 """Application metrics API (reference: python/ray/util/metrics.py).
 
-Counter/Gauge/Histogram recorded in-process and periodically flushed to the
-GCS KV under the ``metrics`` namespace; ``scrape_metrics`` aggregates them
-(a Prometheus endpoint rides on top of this in the dashboard-lite tier).
+Counter/Gauge/Histogram recorded in-process and AUTO-published: every
+worker/driver flushes its registry to the GCS KV (``metrics`` namespace)
+every ``metrics_flush_interval_s`` via the core worker's observability loop
+(``core_worker._obs_flush_loop``), and every raylet does the same for its
+node gauges (``raylet._metrics_loop``) — no manual ``publish_metrics()``
+call needed. The dashboard's ``/metrics`` endpoint aggregates all process
+snapshots into one Prometheus text exposition (histograms included, with
+cumulative ``_bucket``/``_count``/``_sum`` series).
+
+Built-in always-on instruments (reference: metric_defs.cc): task E2E and
+execution latency histograms tagged by function, raylet lease-queue depth,
+object-store bytes + spill counts, and per-loop event-loop lag gauges.
 """
 
 from __future__ import annotations
@@ -95,7 +104,8 @@ class Histogram(_Metric):
 
     def snapshot(self):
         return {"counts": {k: list(v) for k, v in self._counts.items()},
-                "sums": dict(self._sums)}
+                "sums": dict(self._sums),
+                "boundaries": list(self.boundaries)}
 
 
 def scrape_metrics() -> Dict[str, dict]:
@@ -109,14 +119,22 @@ def scrape_metrics() -> Dict[str, dict]:
 
 
 def publish_metrics():
-    """Push this process's metrics to the GCS KV (metrics namespace)."""
+    """Push this process's metrics to the GCS KV (metrics namespace) NOW.
+
+    Normally unnecessary: the runtime auto-publishes every
+    ``metrics_flush_interval_s``. Kept for forcing an immediate flush
+    (e.g. right before reading ``/metrics`` in a test)."""
     import os
     from ray_tpu._private import wire
 
     from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.core_worker import _obs_proc_tag
 
     core = worker_mod.global_worker()
-    payload = {"pid": os.getpid(), "time": time.time(), "metrics": scrape_metrics()}
+    payload = {"pid": os.getpid(), "time": time.time(),
+               "node": getattr(core, "node_hex", ""),
+               "metrics": scrape_metrics()}
+    # same key as the auto-flusher so the dashboard never double-counts
     core._run(core._gcs_call("KVPut", {
-        "ns": "metrics", "key": f"proc_{os.getpid()}",
+        "ns": "metrics", "key": f"proc_{_obs_proc_tag}",
         "value": wire.dumps(payload)}))

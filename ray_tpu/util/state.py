@@ -32,6 +32,48 @@ def list_placement_groups() -> List[Dict[str, Any]]:
     return _state()["pgs"]
 
 
+def _core():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker()
+
+
+def list_tasks(job_id: Optional[str] = None, name: Optional[str] = None,
+               state_filter: Optional[str] = None,
+               limit: int = 200) -> List[Dict[str, Any]]:
+    """Task lifecycle records from the GCS task manager (reference:
+    ``ray list tasks`` backed by GcsTaskManager). Each record carries the
+    full timestamped state-transition history (SUBMITTED →
+    LEASE_REQUESTED → SCHEDULED → RUNNING → FINISHED/FAILED, plus RETRYING
+    entries with attempt count and error summary)."""
+    core = _core()
+    if getattr(core, "mode", "") == "local":
+        return []  # local mode executes inline; there is no lifecycle
+    return core._run(core._gcs_call("ListTasks", {
+        "job_id": job_id, "name": name, "state": state_filter,
+        "limit": limit}), 30.0)["tasks"]
+
+
+def get_task(task_id: str) -> Optional[Dict[str, Any]]:
+    """One task's lifecycle record by hex task id (``ray get tasks``)."""
+    core = _core()
+    if getattr(core, "mode", "") == "local":
+        return None
+    return core._run(core._gcs_call("GetTask", {"task_id": task_id}),
+                     30.0)["task"]
+
+
+def summarize_tasks(job_id: Optional[str] = None) -> Dict[str, Any]:
+    """Per-function counts by lifecycle state — the ``ray summary tasks``
+    analog. Includes the GCS-side drop counters so ring truncation is
+    visible."""
+    core = _core()
+    if getattr(core, "mode", "") == "local":
+        return {"per_function": {}, "total": 0, "dropped": {}}
+    return core._run(core._gcs_call("SummarizeTasks", {"job_id": job_id}),
+                     30.0)
+
+
 def get_node_stats(node_address: str, agent: bool = False) -> Dict[str, Any]:
     """Raylet-side stats; agent=True adds the per-node agent sample (node
     cpu/mem/load + per-worker cpu/rss, reference: dashboard

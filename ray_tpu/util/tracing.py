@@ -1,13 +1,23 @@
-"""Task tracing + profile events with chrome://tracing export.
+"""Causal task tracing + profile events with chrome://tracing export.
 
 Reference: the reference captures per-task profile events in C++
 (``core_worker/profile_event.cc``) into a ``TaskEventBuffer``
 (``task_event_buffer.cc``) that flushes to the GCS ``GcsTaskManager`` and
 feeds the dashboard timeline; opt-in OpenTelemetry spans wrap remote calls
+and propagate trace context through the TaskSpec
 (``util/tracing/tracing_helper.py:326``). Here every worker buffers span
 records and flushes them to the GCS KV (``trace`` namespace); the driver
 gathers them with :func:`get_spans` and writes a chrome://tracing JSON
 timeline with :func:`export_chrome_trace` (also ``ray-tpu timeline``).
+
+Causality: every span carries ``trace_id``/``span_id``/``parent_id``.
+The active span rides a :mod:`contextvars` context var; ``submit_task`` /
+actor submission stamp the caller's active span into the ``TaskSpec``
+(``trace_id``/``parent_span_id``), and task execution installs the task's
+span as current, so nested ``.remote()`` calls and :func:`profile` blocks
+form a tree that spans processes. :func:`export_chrome_trace` emits
+chrome-trace flow events (``ph: "s"/"f"``) for every cross-thread edge, so
+driver→actor→nested-task causality renders as arrows in Perfetto.
 
 Enable with ``RAY_TPU_ENABLE_TRACING=1`` (on the driver: before init — the
 flag propagates to workers through the runtime env) or per-session via
@@ -19,14 +29,16 @@ flag propagates to workers through the runtime env) or per-session via
 
 from __future__ import annotations
 
+import atexit
 import contextlib
+import contextvars
 import json
 import os
 from ray_tpu._private import wire
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 _lock = threading.Lock()
 _buffer: List[dict] = []
@@ -40,6 +52,44 @@ _last_flush = time.time()
 _timer: Optional[threading.Timer] = None
 # cluster-unique flush-key tag (pids collide across nodes/restarts)
 _proc_tag = uuid.uuid4().hex[:10]
+
+# ---------------------------------------------------------------------------
+# trace context (reference: tracing_helper.py's _opentelemetry context
+# propagation — here a plain (trace_id, span_id) pair on a ContextVar, so it
+# follows asyncio tasks automatically and can be installed on pool threads)
+# ---------------------------------------------------------------------------
+
+_ctx: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_context() -> Optional[Tuple[str, str]]:
+    """The active (trace_id, span_id), or None outside any span."""
+    return _ctx.get()
+
+
+def set_context(trace_id: str, span_id: str):
+    """Install (trace_id, span_id) as the active span; returns a token for
+    :func:`reset_context`. Used by task execution so nested ``.remote()``
+    calls and :func:`profile` blocks parent onto the running task's span."""
+    return _ctx.set((trace_id, span_id))
+
+
+def reset_context(token) -> None:
+    try:
+        _ctx.reset(token)
+    except ValueError:
+        # token from another context (e.g. exec-pool thread reuse): clearing
+        # is the right fallback — never let a stale span leak across tasks
+        _ctx.set(None)
 
 
 def enabled() -> bool:
@@ -55,11 +105,44 @@ def enable():
     _enabled = True
 
 
+# -- tail-span protection: without this, spans recorded in the last
+# _FLUSH_INTERVAL_S before process exit die with the pending _timer --
+_atexit_registered = False
+
+
+def _flush_at_exit():
+    try:
+        flush()
+    except Exception:
+        pass
+    try:
+        from ray_tpu._private import task_events
+
+        task_events.flush()
+    except Exception:
+        pass
+
+
+def _ensure_atexit():
+    global _atexit_registered
+    if not _atexit_registered:
+        _atexit_registered = True
+        atexit.register(_flush_at_exit)
+
+
 def record_span(name: str, start_s: float, end_s: float,
                 category: str = "task", **extra):
-    """Buffer one span; flushes to the GCS every _FLUSH_EVERY spans."""
+    """Buffer one span; flushes to the GCS every _FLUSH_EVERY spans.
+
+    Span causality fields (``trace_id``/``span_id``/``parent_id``) are
+    filled from the active context when not passed explicitly."""
     if not enabled():
         return
+    if "trace_id" not in extra:
+        ctx = _ctx.get()
+        if ctx is not None:
+            extra["trace_id"] = ctx[0]
+            extra.setdefault("parent_id", ctx[1])
     span = {
         "name": name,
         "cat": category,
@@ -72,20 +155,21 @@ def record_span(name: str, start_s: float, end_s: float,
     global _timer
     flush_now = False
     with _lock:
+        _ensure_atexit()
         _buffer.append(span)
         if len(_buffer) > _MAX_BUFFER:
             del _buffer[: len(_buffer) - _MAX_BUFFER]
         if len(_buffer) >= _FLUSH_EVERY:
-            # size-triggered flushes are synchronous (backpressure);
-            # time-triggered ones run on the timer thread so sporadic user
-            # spans never pay a GCS round-trip inline
+            # size-triggered flushes hand off without waiting for the GCS
+            # round trip (a traced submit loop must not stall every 32
+            # spans); boundedness comes from _MAX_BUFFER drop-oldest
             flush_now = True
         elif _timer is None:
             _timer = threading.Timer(_FLUSH_INTERVAL_S, _timer_flush)
             _timer.daemon = True
             _timer.start()
     if flush_now:
-        flush()
+        flush(block=False)
 
 
 def _timer_flush():
@@ -97,16 +181,35 @@ def _timer_flush():
 
 @contextlib.contextmanager
 def profile(name: str, category: str = "user", **extra):
-    """Custom user span (reference: ray.util.tracing via profile events)."""
+    """Custom user span (reference: ray.util.tracing via profile events).
+
+    Runs as a child of the active span (the executing task, or an enclosing
+    profile block) and installs itself as current for the duration, so
+    nested profile blocks and nested ``.remote()`` submissions tree up."""
+    if not enabled():
+        yield
+        return
+    parent = _ctx.get()
+    span_id = new_span_id()
+    trace_id = parent[0] if parent is not None else new_trace_id()
+    token = _ctx.set((trace_id, span_id))
     t0 = time.time()
     try:
         yield
     finally:
-        record_span(name, t0, time.time(), category=category, **extra)
+        reset_context(token)
+        record_span(name, t0, time.time(), category=category,
+                    trace_id=trace_id, span_id=span_id,
+                    parent_id=parent[1] if parent is not None else None,
+                    **extra)
 
 
-def flush():
-    """Push buffered spans to the GCS KV; safe to call anywhere."""
+def flush(block: bool = True):
+    """Push buffered spans to the GCS KV; safe to call anywhere.
+
+    ``block=False`` (the size-triggered path in :func:`record_span`) ships
+    without waiting for the round trip; explicit callers (get_spans,
+    shutdown, atexit) keep the blocking read-your-writes semantics."""
     global _flush_counter, _last_flush
     with _lock:
         _last_flush = time.time()
@@ -150,7 +253,15 @@ def flush():
         if running is not None and running is core.loop:
             # called from the worker's event loop (task-execution path):
             # blocking would deadlock — fire and forget, re-buffer on error
-            asyncio.ensure_future(_put_guarded())
+            from ray_tpu._private.async_util import spawn
+
+            spawn(_put_guarded(), what="trace-span flush")
+        elif not block:
+            # async hand-off: ship on the io loop, don't await the ack
+            # (_put_guarded re-buffers on failure)
+            import asyncio as _asyncio
+
+            _asyncio.run_coroutine_threadsafe(_put_guarded(), core.loop)
         else:
             core._run(_put_guarded())
     except Exception:
@@ -197,9 +308,17 @@ def clear():
                                        "prefix": True}))
 
 
+_SPAN_META = ("name", "cat", "ts", "dur", "pid", "tid")
+
+
 def export_chrome_trace(path: str) -> int:
     """Write a chrome://tracing (about://tracing, Perfetto) JSON file.
-    Returns the number of events written."""
+
+    Besides the ``ph: "X"`` duration slices, every parent→child span edge
+    that crosses a thread or process emits a flow-event pair (``ph: "s"`` on
+    the parent slice, ``ph: "f"`` on the child slice) so cross-process
+    causality — driver submit → actor task → nested task — renders as
+    arrows. Returns the number of events written."""
     spans = get_spans()
     events = [
         {
@@ -210,11 +329,35 @@ def export_chrome_trace(path: str) -> int:
             "dur": max(s["dur"], 0.0) * 1e6,
             "pid": s.get("pid", 0),
             "tid": s.get("tid", 0),
-            "args": {k: v for k, v in s.items()
-                     if k not in ("name", "cat", "ts", "dur", "pid", "tid")},
+            "args": {k: v for k, v in s.items() if k not in _SPAN_META},
         }
         for s in spans
     ]
+    by_id = {s["span_id"]: s for s in spans if s.get("span_id")}
+    flow_n = 0
+    for s in spans:
+        parent = by_id.get(s.get("parent_id") or "")
+        if parent is None:
+            continue
+        same_track = (parent.get("pid"), parent.get("tid")) == \
+            (s.get("pid"), s.get("tid"))
+        if same_track:
+            continue  # same-thread nesting already renders as stacked slices
+        flow_n += 1
+        # the flow-start ts must land inside the parent slice for Perfetto
+        # to bind the arrow to it
+        start_ts = min(max(s["ts"], parent["ts"]),
+                       parent["ts"] + max(parent["dur"], 0.0))
+        events.append({
+            "name": "task_flow", "cat": "flow", "ph": "s", "id": flow_n,
+            "ts": start_ts * 1e6, "pid": parent.get("pid", 0),
+            "tid": parent.get("tid", 0),
+        })
+        events.append({
+            "name": "task_flow", "cat": "flow", "ph": "f", "bp": "e",
+            "id": flow_n, "ts": s["ts"] * 1e6, "pid": s.get("pid", 0),
+            "tid": s.get("tid", 0),
+        })
     with open(path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return len(events)

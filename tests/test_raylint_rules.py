@@ -549,3 +549,63 @@ def test_overlapping_paths_lint_each_file_once(tmp_path):
     report = core.check_paths([tmp_path, mod.parent, mod], tmp_path)
     assert report.files_checked == 1
     assert len(report.findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# ASY003 — leaked asyncio tasks
+# ---------------------------------------------------------------------------
+
+
+def test_asy003_positive_bare_statements():
+    findings = lint("""
+        import asyncio
+
+        class S:
+            def kick(self):
+                asyncio.ensure_future(self._work())
+
+            def kick2(self):
+                asyncio.create_task(self._work())
+
+            def kick3(self):
+                self.loop.create_task(self._work())
+    """, rules=["ASY003"])
+    assert rules_of(findings) == ["ASY003"] * 3
+    assert "done-callback" in findings[0].message
+
+
+def test_asy003_positive_lambda_callback():
+    findings = lint("""
+        import asyncio
+
+        def arm(loop, client):
+            loop.call_later(30.0, lambda: asyncio.ensure_future(client.close()))
+    """, rules=["ASY003"])
+    assert rules_of(findings) == ["ASY003"]
+
+
+def test_asy003_negative_owned_tasks():
+    findings = lint("""
+        import asyncio
+        from ray_tpu._private.async_util import spawn
+
+        class S:
+            async def run(self):
+                t = asyncio.ensure_future(self._work())       # stored
+                self._background.append(asyncio.ensure_future(self._loop()))
+                await asyncio.ensure_future(self._work())     # awaited
+                asyncio.ensure_future(self._work()).add_done_callback(self._cb)
+                spawn(self._work(), what="sanctioned helper")
+                return t
+    """, rules=["ASY003"])
+    assert rules_of(findings) == []
+
+
+def test_asy003_suppression():
+    findings = lint("""
+        import asyncio
+
+        def kick(self):
+            asyncio.ensure_future(self._work())  # raylint: disable=ASY003 guarded internally
+    """, rules=["ASY003"])
+    assert rules_of(findings) == []

@@ -1,0 +1,143 @@
+"""Observability overhead microbenchmark (``python -m tools.bench_obs``).
+
+Measures what the task-event pipeline and tracing layer cost, so future
+rounds can hold the line on "observability is pay-for-what-you-use":
+
+* ``span_record_per_s``       — tracing.record_span throughput (enabled)
+* ``event_record_us``         — one task_events.record() call (enabled)
+* ``event_flush_us_per_task`` — amortized per-task cost of the 4-transition
+                                record + batched AddTaskEvents flush
+* ``submit_us_*``             — end-to-end no-op task latency with
+                                observability fully off (baseline), task
+                                events on (default config), and tracing on
+* ``*_delta_pct``             — overhead relative to the disabled baseline
+
+Emits one JSON object on stdout (plus --out FILE) so BENCH rounds can
+track regressions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _bench_span_record(n: int = 20_000) -> float:
+    from ray_tpu.util import tracing
+
+    t0 = time.perf_counter()
+    now = time.time()
+    for i in range(n):
+        tracing.record_span("bench_span", now, now + 1e-6,
+                            category="bench", idx=i)
+    dt = time.perf_counter() - t0
+    tracing.flush()
+    return n / dt
+
+
+def _bench_event_record(n: int = 20_000) -> float:
+    from ray_tpu._private import task_events
+
+    t0 = time.perf_counter()
+    for i in range(n):
+        task_events.record(f"bench{i:08x}", task_events.SUBMITTED,
+                           name="bench", job_id="bench")
+    dt = time.perf_counter() - t0
+    task_events.drain()  # don't ship 20k synthetic events to the GCS
+    return dt / n * 1e6
+
+
+def _bench_event_flush(n_tasks: int = 2_000) -> float:
+    """4 transitions per task + a real AddTaskEvents flush, amortized."""
+    from ray_tpu._private import task_events
+
+    t0 = time.perf_counter()
+    for i in range(n_tasks):
+        tid = f"flush{i:08x}"
+        for st in (task_events.SUBMITTED, task_events.SCHEDULED,
+                   task_events.RUNNING, task_events.FINISHED):
+            task_events.record(tid, st, name="bench_flush", job_id="bench")
+    task_events.flush()
+    return (time.perf_counter() - t0) / n_tasks * 1e6
+
+
+def _bench_submission_configs(ray_tpu, configs, rounds: int = 4,
+                              n: int = 200):
+    """Measure no-op task submit+complete latency under each observability
+    config. Rounds are INTERLEAVED across configs (a-b-c, a-b-c, ...) so
+    cluster warmup/noise drift hits every config equally; reports the
+    per-config minimum."""
+    @ray_tpu.remote
+    def _noop(i):
+        return i
+
+    # warmup: function push + worker lease
+    ray_tpu.get([_noop.remote(i) for i in range(20)], timeout=120)
+    best = {name: float("inf") for name, _ in configs}
+    for _ in range(rounds):
+        for name, apply in configs:
+            apply()
+            t0 = time.perf_counter()
+            ray_tpu.get([_noop.remote(i) for i in range(n)], timeout=300)
+            best[name] = min(best[name],
+                             (time.perf_counter() - t0) / n * 1e6)
+    return best
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="")
+    parser.add_argument("--tasks", type=int, default=200)
+    parser.add_argument("--rounds", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu._private import task_events
+    from ray_tpu.util import tracing
+
+    ray_tpu.init(num_cpus=4)
+    out = {}
+
+    def _off():
+        task_events.set_enabled(False)
+        tracing._enabled = False
+
+    def _events():
+        task_events.set_enabled(True)
+        tracing._enabled = False
+
+    def _traced():
+        task_events.set_enabled(True)
+        tracing._enabled = True
+
+    best = _bench_submission_configs(
+        ray_tpu,
+        [("disabled", _off), ("events", _events), ("traced", _traced)],
+        args.rounds, args.tasks)
+    out["submit_us_disabled"] = best["disabled"]
+    out["submit_us_events"] = best["events"]
+    out["submit_us_traced"] = best["traced"]
+
+    out["events_delta_pct"] = 100.0 * (
+        out["submit_us_events"] / out["submit_us_disabled"] - 1.0)
+    out["traced_delta_pct"] = 100.0 * (
+        out["submit_us_traced"] / out["submit_us_disabled"] - 1.0)
+
+    out["span_record_per_s"] = _bench_span_record()
+    out["event_record_us"] = _bench_event_record()
+    out["event_flush_us_per_task"] = _bench_event_flush()
+
+    tracing._enabled = None
+    task_events.set_enabled(None)
+    ray_tpu.shutdown()
+
+    print(json.dumps(out, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+    return out
+
+
+if __name__ == "__main__":
+    main()

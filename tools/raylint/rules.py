@@ -17,6 +17,10 @@ suppression guidance per rule.
 * TRC001 — a JAX tracer escaping into actor/object state: a value stored on
   ``self`` or shipped through ``.remote()``/``ray_tpu.put()`` from inside a
   ``jit``/``grad``-traced function.
+* ASY003 — a leaked asyncio task: ``asyncio.ensure_future``/``create_task``
+  whose result is neither awaited, stored, nor given a done-callback — its
+  exception is swallowed until GC (often never); use
+  ``ray_tpu._private.async_util.spawn``.
 """
 
 from __future__ import annotations
@@ -232,6 +236,69 @@ class AwaitUnderThreadLock(Rule):
         v = V(module)
         v.visit(module.tree)
         return iter(v.findings)
+
+
+# ---------------------------------------------------------------------------
+# ASY003 — leaked asyncio tasks (fire-and-forget without an owner)
+# ---------------------------------------------------------------------------
+
+# Spawning calls whose returned task must not be discarded: a task whose
+# result nobody ever retrieves reports its exception only when the task
+# object is garbage-collected — "Task exception was never retrieved",
+# minutes later or never. On the control plane that converts a crashed
+# scheduling/flush loop into a silent distributed hang.
+_SPAWN_CALLS = {"asyncio.ensure_future", "asyncio.create_task"}
+_SPAWN_METHODS = {"ensure_future", "create_task"}
+
+
+def _is_spawn_call(node: ast.Call, resolver) -> bool:
+    dotted = resolver.dotted(node.func)
+    if dotted in _SPAWN_CALLS:
+        return True
+    # loop.create_task(...) / self.loop.create_task(...): method form on
+    # anything whose name mentions a loop
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SPAWN_METHODS:
+        recv = resolver.dotted(node.func.value) or ""
+        return "loop" in recv.lower()
+    return False
+
+
+@register_rule
+class LeakedAsyncioTask(Rule):
+    name = "ASY003"
+    summary = ("fire-and-forget asyncio task: its exception is swallowed "
+               "until GC (often never); store/await it or use "
+               "async_util.spawn (done-callback logging)")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        findings = []
+        for node in ast.walk(module.tree):
+            # only a bare expression STATEMENT discards the task; an
+            # assignment, append(...) argument, await, or chained
+            # .add_done_callback(...) all keep an owner
+            if not isinstance(node, ast.Expr):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call) and _is_spawn_call(
+                    value, module.resolver):
+                findings.append(self.finding(
+                    module, value,
+                    "spawned task is neither awaited, stored, nor given a "
+                    "done-callback — its exception dies with the task "
+                    "object; use ray_tpu._private.async_util.spawn(...) "
+                    "(or keep a handle / add_done_callback)"))
+            # lambda bodies passed to call_later/call_soon share the leak
+            elif isinstance(value, ast.Call):
+                for arg in value.args:
+                    if isinstance(arg, ast.Lambda) \
+                            and isinstance(arg.body, ast.Call) \
+                            and _is_spawn_call(arg.body, module.resolver):
+                        findings.append(self.finding(
+                            module, arg.body,
+                            "fire-and-forget task spawned inside a lambda "
+                            "callback; route through async_util.spawn so "
+                            "failures are logged"))
+        return iter(findings)
 
 
 # ---------------------------------------------------------------------------
