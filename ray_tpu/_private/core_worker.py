@@ -1645,7 +1645,8 @@ class CoreWorker:
         if task_events.enabled():
             record["_job_hex"] = jh = self.job_id.hex()
             task_events.record(task_id.hex(), task_events.SUBMITTED,
-                               name=record["name"], job_id=jh)
+                               name=record["name"], job_id=jh,
+                               arg_bytes=len(args_blob))
         for oid, owner in arg_refs:
             self.ref_counter.pin(oid, owner)
         record["_pinned"] = True
@@ -1764,7 +1765,8 @@ class CoreWorker:
         if wait:
             await record["_done"].wait()
 
-    def _observe_complete(self, record, err: Optional[TaskError]):
+    def _observe_complete(self, record, err: Optional[TaskError],
+                          ret_bytes: int = 0):
         """Terminal lifecycle event + end-to-end latency histogram (the
         always-on half of observability: costs one histogram observe and,
         when task events are on, a buffered append)."""
@@ -1783,11 +1785,27 @@ class CoreWorker:
                 attempt=max(record.get("attempts", 0),
                             record.get("epoch", 0) or 0),
                 error=str(err) if err is not None else "",
-                job_id=record.get("_job_hex", ""))
+                job_id=record.get("_job_hex", ""),
+                ret_bytes=ret_bytes)
+
+    @staticmethod
+    def _result_nbytes(results) -> int:
+        """Serialized return-payload bytes of a completed task: inline
+        results carry their blob, store-resident ones ride the executor's
+        size annotation (the payload slot of a ``("store", nbytes)``
+        result tuple)."""
+        total = 0
+        for kind, payload in results:
+            if kind == "inline":
+                total += len(payload)
+            elif isinstance(payload, int):
+                total += payload
+        return total
 
     def _complete_ok(self, record, results, stream_count=None):
         record["_completed"] = True
-        self._observe_complete(record, None)
+        self._observe_complete(record, None,
+                               ret_bytes=self._result_nbytes(results))
         if record["spec"].num_returns == -1:
             st = self._streams.get(record["spec"].task_id.binary())
             if st is not None:
@@ -2066,7 +2084,8 @@ class CoreWorker:
         if task_events.enabled():
             record["_job_hex"] = jh = self.job_id.hex()
             task_events.record(task_id.hex(), task_events.SUBMITTED,
-                               name=record["name"], job_id=jh)
+                               name=record["name"], job_id=jh,
+                               arg_bytes=len(args_blob))
         for oid, owner in arg_refs:
             self.ref_counter.pin(oid, owner)
         record["_pinned"] = True
@@ -3132,7 +3151,9 @@ class CoreWorker:
             else:
                 await self._store_blob(oid, inband, buffers, spec.attempt,
                                        owner=spec.owner_address)
-                results.append(("store", None))
+                # the size annotation feeds the owner's per-task
+                # returned-object-bytes accounting (task events)
+                results.append(("store", total))
                 if inner:
                     # stored blobs hold refs only as bytes: the owner must
                     # pin them for the blob's lifetime

@@ -174,6 +174,7 @@ class GcsTaskManager:
                 rec = ring[tid] = self._by_tid[tid] = {
                     "task_id": tid, "job_id": job, "name": "", "state": "",
                     "attempt": 0, "error": "", "worker": "", "node": "",
+                    "arg_bytes": 0, "ret_bytes": 0,
                     "events": [], "_last_ts": 0.0,
                 }
             self._merge(rec, ev)
@@ -200,6 +201,13 @@ class GcsTaskManager:
             rec["node"] = ev["node"]
         if ev.get("error"):
             rec["error"] = ev["error"]
+        # object-size accounting: arg bytes ride SUBMITTED, return bytes
+        # the terminal event; max() keeps the merge idempotent under
+        # replays and retry re-submissions report their largest attempt
+        if ev.get("arg_bytes"):
+            rec["arg_bytes"] = max(rec["arg_bytes"], int(ev["arg_bytes"]))
+        if ev.get("ret_bytes"):
+            rec["ret_bytes"] = max(rec["ret_bytes"], int(ev["ret_bytes"]))
         rec["attempt"] = max(rec["attempt"], ev.get("attempt", 0))
         # latest-state resolution: owner and executor flush independently,
         # so events can arrive out of ts order; a terminal state is never
@@ -245,8 +253,10 @@ class GcsTaskManager:
 
     def summarize(self, job_id: Optional[str] = None) -> dict:
         """Per-function counts by lifecycle state (the ``ray summary
-        tasks`` analog)."""
+        tasks`` analog), plus per-function object-size accounting
+        (summed serialized argument / returned-object bytes)."""
         per_fn: Dict[str, Dict[str, int]] = {}
+        sizes: Dict[str, Dict[str, int]] = {}
         total = 0
         for job, ring in self.jobs.items():
             if job_id and job != job_id:
@@ -257,8 +267,11 @@ class GcsTaskManager:
                 by_state = per_fn.setdefault(fn, {})
                 st = rec["state"] or "UNKNOWN"
                 by_state[st] = by_state.get(st, 0) + 1
-        return {"per_function": per_fn, "total": total,
-                "dropped": dict(self.dropped)}
+                sz = sizes.setdefault(fn, {"arg_bytes": 0, "ret_bytes": 0})
+                sz["arg_bytes"] += rec.get("arg_bytes", 0)
+                sz["ret_bytes"] += rec.get("ret_bytes", 0)
+        return {"per_function": per_fn, "per_function_bytes": sizes,
+                "total": total, "dropped": dict(self.dropped)}
 
 
 class GcsServer:

@@ -66,8 +66,13 @@ def set_enabled(value: Optional[bool]):
 
 def record(task_id_hex: str, state: str, *, name: str = "", job_id: str = "",
            attempt: int = 0, error: str = "", worker: str = "",
-           node: str = "") -> None:
-    """Buffer one state transition. Cheap (lock + append); never raises."""
+           node: str = "", arg_bytes: int = 0, ret_bytes: int = 0) -> None:
+    """Buffer one state transition. Cheap (lock + append); never raises.
+
+    ``arg_bytes`` rides the owner's SUBMITTED event (serialized argument
+    payload size), ``ret_bytes`` the terminal FINISHED event (serialized
+    return payload size, inline or store-resident) — the per-task object
+    accounting surfaced by ``summarize_tasks``."""
     if not enabled():
         return
     event: Dict[str, Any] = {"task_id": task_id_hex, "state": state,
@@ -76,6 +81,10 @@ def record(task_id_hex: str, state: str, *, name: str = "", job_id: str = "",
         event["name"] = name
     if job_id:
         event["job_id"] = job_id
+    if arg_bytes:
+        event["arg_bytes"] = int(arg_bytes)
+    if ret_bytes:
+        event["ret_bytes"] = int(ret_bytes)
     if error:
         # summary, not transcript: first line, bounded (full tracebacks
         # stay in worker logs / the task's error object)

@@ -1,0 +1,74 @@
+"""ray_tpu.ckpt: async sharded checkpointing with content-addressed chunks.
+
+The checkpoint plane is the durable sibling of the weight plane
+(``ray_tpu/weights``): the same ``(leaf, shard box)`` chunk geometry, but
+committed to storage as an immutable manifest + content-addressed chunk
+files instead of published to a live store actor. See
+``ray_tpu/ckpt/README.md`` for the design.
+
+Public surface::
+
+    from ray_tpu import ckpt
+
+    store = ckpt.CheckpointStore("/mnt/ckpts/run1", keep_last=5)
+    saver = ckpt.CheckpointSaver(store)
+    cid = saver.save(state, step=n)          # bounded pause, async commit
+    saver.wait()                             # barrier (e.g. before exit)
+
+    tree = ckpt.restore_tree(store)          # latest, full tree
+    shards, stats = ckpt.restore_shards(store, dst_spec, host)
+    plan = ckpt.restore_plan(store.latest(), dst_spec)  # no_gather() etc.
+
+    store.pin(cid); store.retention(keep_last=3)
+    ckpt.diff_manifests(store.read(a), store.read(b))   # chunk delta
+"""
+
+# Lazy exports (PEP 562), mirroring ray_tpu.weights: the plane pulls in
+# numpy + the weights geometry, which must not ride along into processes
+# that never checkpoint.
+_EXPORTS = {
+    "Manifest": "manifest", "LeafEntry": "manifest",
+    "atomic_write": "manifest", "diff_manifests": "manifest",
+    "new_ckpt_id": "manifest",
+    "CheckpointStore": "store",
+    "CheckpointSaver": "saver", "save_checkpoint": "saver",
+    "save_host_shards": "saver", "commit_host_parts": "saver",
+    "snapshot_tree": "saver",
+    "restore_tree": "restore", "restore_shards": "restore",
+    "restore_plan": "restore", "restore_spec": "restore",
+    "restore_tree_shards": "restore",
+}
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'ray_tpu.ckpt' has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"ray_tpu.ckpt.{mod}"), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
+
+__all__ = [
+    "Manifest",
+    "LeafEntry",
+    "CheckpointStore",
+    "CheckpointSaver",
+    "atomic_write",
+    "save_checkpoint",
+    "save_host_shards",
+    "commit_host_parts",
+    "snapshot_tree",
+    "restore_tree",
+    "restore_shards",
+    "restore_plan",
+    "restore_spec",
+    "restore_tree_shards",
+    "diff_manifests",
+    "new_ckpt_id",
+]

@@ -1,0 +1,192 @@
+"""Restore: full-tree rebuild and restore-time resharding.
+
+Restore is read-shaped like the weight plane's pull: a replicated consumer
+(``restore``) rebuilds the whole tree; a sharded consumer
+(``restore_shards``) names its target geometry (``MeshSpec`` + partitions
+or a full ``ShardedTreeSpec``) and a host, and reads ONLY the chunk files
+intersecting that host's destination boxes. When the target mesh differs
+from the saved one, the saved spec + target spec run through the weight
+plane's planner (``weights/plan.plan_reshard``) — ``restore_plan`` exposes
+the plan so callers can assert ``no_gather()`` before touching a byte,
+and the per-host chunk reads are exactly the plan's receive edges: no
+host ever materializes a full leaf it does not declare replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.ckpt import manifest as mf
+from ray_tpu.ckpt.store import CheckpointStore
+
+
+def restore_spec(manifest: mf.Manifest):
+    """The ``ShardedTreeSpec`` a checkpoint was saved under (array leaves
+    only — opaque ``py`` leaves have no geometry and always replicate)."""
+    from ray_tpu.weights.spec import MeshSpec, ShardedTreeSpec
+    from ray_tpu.weights.store import _spec_from_payload
+
+    if manifest.spec is not None:
+        return _spec_from_payload(manifest.spec)
+    # unsharded save: single-host geometry, every leaf replicated
+    return ShardedTreeSpec(
+        mesh=MeshSpec.host_mesh(["ckpt"]),
+        parts={p: () for p, e in manifest.leaves.items() if e.kind == mf.ND},
+        meta={p: (tuple(e.shape), e.dtype)
+              for p, e in manifest.leaves.items() if e.kind == mf.ND})
+
+
+def restore_plan(manifest: mf.Manifest, dst_spec):
+    """The reshard plan a sharded restore will execute (saved geometry ->
+    ``dst_spec``). Callers assert plan-level invariants (``no_gather()``,
+    byte counts) against it."""
+    from ray_tpu.weights.plan import plan_reshard
+
+    src = restore_spec(manifest)
+    dst_meta = dict(dst_spec.meta)
+    src_meta = {p: m for p, m in src.meta.items() if p in dst_meta}
+    import dataclasses as _dc
+
+    src = _dc.replace(src, meta=src_meta,
+                      parts={p: src.parts.get(p, ()) for p in src_meta})
+    return plan_reshard(src, dst_spec)
+
+
+def _py_leaves(store: CheckpointStore, manifest: mf.Manifest) -> Dict[str, Any]:
+    from ray_tpu._private.serialization import loads_oob
+
+    out = {}
+    for path, entry in manifest.leaves.items():
+        if entry.kind == mf.PY:
+            h, _ = entry.chunks[""]
+            out[path] = loads_oob(mf.read_chunk(store.root, h))
+    return out
+
+
+def restore_tree(store: CheckpointStore, ckpt_id: Optional[str] = None,
+            *, timeout: float = 30.0) -> Any:
+    """Rebuild the FULL tree of ``ckpt_id`` (default: latest committed).
+    For replicated consumers only — sharded consumers use
+    :func:`restore_shards` and never hold a gathered leaf."""
+    import numpy as np
+
+    from ray_tpu.weights.spec import box_slices, unflatten_tree
+
+    if ckpt_id is None:
+        manifest = store.latest()
+        if manifest is None:
+            raise FileNotFoundError(
+                f"checkpoint store {store.root!r} has no committed "
+                f"checkpoint")
+    else:
+        manifest = store.wait_for(ckpt_id, timeout=timeout)
+    leaves: Dict[str, Any] = _py_leaves(store, manifest)
+    for path, entry in manifest.leaves.items():
+        if entry.kind != mf.ND:
+            continue
+        dt = np.dtype(entry.dtype)
+        out = np.empty(entry.shape, dtype=dt)
+        for box_s, (h, _nb) in entry.chunks.items():
+            box = mf.decode_box(box_s) or tuple((0, s) for s in entry.shape)
+            data = np.frombuffer(mf.read_chunk(store.root, h), dtype=dt)
+            out[box_slices(box)] = data.reshape(
+                tuple(b - a for a, b in box))
+        leaves[path] = out
+    return unflatten_tree(manifest.skeleton, leaves)
+
+
+def restore_shards(store: CheckpointStore, dst_spec, host: str,
+                   ckpt_id: Optional[str] = None, *,
+                   timeout: float = 30.0,
+                   ) -> Tuple[Dict[str, Dict[Any, Any]], Dict[str, Any]]:
+    """Read exactly ``host``'s destination shards of ``dst_spec`` from the
+    checkpoint, resharding through the saved geometry. Returns
+    ``({leaf: {dst_box: array}}, stats)`` where stats carries the bytes
+    actually read and the plan's invariants; no full leaf is ever
+    materialized unless a destination box IS the full leaf."""
+    import numpy as np
+
+    from ray_tpu.weights.spec import host_boxes, intersect_box, rel_slices
+
+    if ckpt_id is None:
+        manifest = store.latest()
+        if manifest is None:
+            raise FileNotFoundError(
+                f"checkpoint store {store.root!r} has no committed "
+                f"checkpoint")
+    else:
+        manifest = store.wait_for(ckpt_id, timeout=timeout)
+    plan = restore_plan(manifest, dst_spec)
+    out: Dict[str, Dict[Any, Any]] = {}
+    bytes_read = 0
+    chunks_read = 0
+    cache: Dict[str, np.ndarray] = {}
+    for leaf, (shape, dtype) in dst_spec.meta.items():
+        entry = manifest.leaves.get(leaf)
+        if entry is None or entry.kind != mf.ND:
+            raise KeyError(f"checkpoint {manifest.ckpt_id!r} has no array "
+                           f"leaf {leaf!r}")
+        dt = np.dtype(dtype)
+        chunk_boxes = [
+            (mf.decode_box(bs) or tuple((0, s) for s in entry.shape), h, nb)
+            for bs, (h, nb) in entry.chunks.items()]
+        out[leaf] = {}
+        for dbox in host_boxes(dst_spec.mesh, dst_spec.part_of(leaf),
+                               shape, host):
+            shard = np.empty(tuple(b - a for a, b in dbox), dtype=dt)
+            for cbox, h, _nb in chunk_boxes:
+                inter = intersect_box(dbox, cbox)
+                if inter is None:
+                    continue
+                chunk = cache.get(h)
+                if chunk is None:
+                    chunk = np.frombuffer(
+                        mf.read_chunk(store.root, h), dtype=dt).reshape(
+                        tuple(b - a for a, b in cbox))
+                    cache[h] = chunk
+                    bytes_read += chunk.nbytes
+                    chunks_read += 1
+                shard[rel_slices(inter, dbox)] = chunk[rel_slices(inter, cbox)]
+            out[leaf][dbox] = shard
+    stats = {"ckpt_id": manifest.ckpt_id, "bytes_read": bytes_read,
+             "chunks_read": chunks_read, "no_gather": plan.no_gather(),
+             "plan": plan.stats()}
+    return out, stats
+
+
+def restore_tree_shards(store: CheckpointStore, num_hosts: int, rank: int,
+                        ckpt_id: Optional[str] = None, *, axis: str = "data",
+                        timeout: float = 30.0) -> Dict[str, Any]:
+    """Convenience for the elastic-train contract (every array leaf sharded
+    along dim 0 across ``num_hosts`` ranks, matching
+    ``train.scaling_policy.mesh_spec_for``): returns ``{"ckpt_id", "tree",
+    "stats"}`` with this rank's dim-0 shard of every array leaf and full
+    copies of opaque leaves."""
+    import dataclasses as _dc
+
+    from ray_tpu.train.scaling_policy import mesh_spec_for
+    from ray_tpu.weights.spec import ShardedTreeSpec, unflatten_tree
+
+    if ckpt_id is None:
+        manifest = store.latest()
+        if manifest is None:
+            raise FileNotFoundError(
+                f"checkpoint store {store.root!r} has no committed "
+                f"checkpoint")
+        ckpt_id = manifest.ckpt_id
+    else:
+        manifest = store.wait_for(ckpt_id, timeout=timeout)
+    mesh = mesh_spec_for(num_hosts, axis=axis)
+    src = restore_spec(manifest)
+    dst = ShardedTreeSpec(
+        mesh=mesh,
+        parts={p: (axis,) + (None,) * (len(shape) - 1)
+               for p, (shape, _) in src.meta.items()},
+        meta=dict(src.meta))
+    shards, stats = restore_shards(store, dst, mesh.hosts[rank], ckpt_id,
+                                   timeout=timeout)
+    leaves = {p: next(iter(boxes.values())) for p, boxes in shards.items()}
+    leaves.update(_py_leaves(store, manifest))
+    return {"ckpt_id": ckpt_id,
+            "tree": unflatten_tree(manifest.skeleton, leaves),
+            "stats": stats}

@@ -91,6 +91,7 @@ class DashboardHead:
             web.get("/api/nodes/{node_id}/stats", self._node_stats),
             web.get("/api/data_stats", self._data_stats),
             web.get("/api/weights", self._weights),
+            web.get("/api/checkpoints", self._checkpoints),
             web.post("/api/profile/stacks", self._profile_stacks),
             web.post("/api/profile/memory", self._profile_memory),
             web.get("/api/jobs", self._jobs_list),
@@ -173,21 +174,32 @@ class DashboardHead:
         out.sort(key=lambda e: e.get("ts", 0))
         return web.json_response(out)
 
+    async def _kv_namespace_dump(self, ns: str) -> dict:
+        """All wire-decoded values of one stats-mirror KV namespace."""
+        keys = (await self._call("KVKeys", {"ns": ns, "prefix": ""}))["keys"]
+        out = {}
+        for k in keys:
+            blob = (await self._call("KVGet", {"ns": ns, "key": k}))["value"]
+            if blob is not None:
+                out[k] = wire.loads(blob)
+        return out
+
     async def _weights(self, request):
         """Weight-plane stores: per-version publish/pull bytes, chunk
         counts, commit timestamps (mirrored to the ``weights`` KV namespace
         by WeightStoreActor on every commit/pull)."""
         from aiohttp import web
 
-        keys = (await self._call("KVKeys",
-                                 {"ns": "weights", "prefix": ""}))["keys"]
-        out = {}
-        for k in keys:
-            blob = (await self._call("KVGet",
-                                     {"ns": "weights", "key": k}))["value"]
-            if blob is not None:
-                out[k] = wire.loads(blob)
-        return web.json_response(out)
+        return web.json_response(await self._kv_namespace_dump("weights"))
+
+    async def _checkpoints(self, request):
+        """Checkpoint-plane stores: per-store latest/pinned ids, per-
+        checkpoint step/bytes/dedup stats and retention drop counters
+        (mirrored to the ``ckpt`` KV namespace by CheckpointStore on every
+        commit/pin/retention)."""
+        from aiohttp import web
+
+        return web.json_response(await self._kv_namespace_dump("ckpt"))
 
     async def _node_stats(self, request):
         """Per-node agent sample: node cpu/mem/load + every worker's

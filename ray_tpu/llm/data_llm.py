@@ -21,18 +21,20 @@ from ray_tpu.llm.config import LLMConfig, SamplingParams
 @ray_tpu.remote
 class _EngineActor:
     def __init__(self, config_blob: bytes, params_blob: Optional[bytes]):
-        import cloudpickle
+        # driver-authored blobs: decode only through the audited
+        # serialization boundary (raylint SER001)
+        from ray_tpu._private.serialization import loads_trusted
 
         from ray_tpu.llm.engine import JaxLLMEngine
 
-        config = cloudpickle.loads(config_blob)
-        params = cloudpickle.loads(params_blob) if params_blob else None
+        config = loads_trusted(config_blob)
+        params = loads_trusted(params_blob) if params_blob else None
         self.engine = JaxLLMEngine(config, params=params)
 
     def generate(self, prompts, params_blob: bytes):
-        import cloudpickle
+        from ray_tpu._private.serialization import loads_trusted
 
-        params = cloudpickle.loads(params_blob)
+        params = loads_trusted(params_blob)
         outs = self.engine.generate(list(prompts), params)
         return [{"text": o.text, "token_ids": o.token_ids,
                  "finish_reason": o.finish_reason} for o in outs]

@@ -423,7 +423,17 @@ class JaxLLMEngine:
 
 
 def _load_params(path: str):
+    """Engine params from ``path``: a checkpoint-plane store (manifest +
+    content-addressed chunks — the format ``save_params`` writes), or the
+    legacy single-file ``params.msgpack`` layout."""
     import os
+
+    from ray_tpu.ckpt import CheckpointStore, restore_tree
+
+    if os.path.isdir(path):
+        store = CheckpointStore(path, name="llm")
+        if store.latest_id() is not None:
+            return restore_tree(store)
 
     import flax.serialization
 
@@ -433,14 +443,18 @@ def _load_params(path: str):
     return flax.serialization.msgpack_restore(blob)
 
 
-def save_params(params: Any, path: str) -> str:
+def save_params(params: Any, path: str, *, step: int = 0) -> str:
+    """Commit engine params through the checkpoint plane: ``path`` becomes
+    a checkpoint store (manifest + chunks). Repeated saves of mostly-
+    unchanged params (a LoRA refresh, an embedding-only update) dedup to
+    the shared chunk pool; a torn save never becomes ``latest``."""
     import os
 
     import flax.serialization
 
-    os.makedirs(path, exist_ok=True)
-    fn = os.path.join(path, "params.msgpack")
-    with open(fn, "wb") as f:
-        f.write(flax.serialization.msgpack_serialize(
-            flax.serialization.to_state_dict(params)))
-    return fn
+    from ray_tpu.ckpt import CheckpointStore, save_checkpoint
+
+    state = flax.serialization.to_state_dict(params)
+    store = CheckpointStore(path, name="llm")
+    manifest = save_checkpoint(store, state, step=step)
+    return os.path.join(path, "manifests", f"{manifest.ckpt_id}.json")

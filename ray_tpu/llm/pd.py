@@ -26,9 +26,11 @@ from ray_tpu.serve import api as serve_api
 def _load_params_blob(params_blob):
     if params_blob is None:
         return None
-    import cloudpickle
+    # driver-authored params blob: decode only through the audited
+    # serialization boundary (raylint SER001)
+    from ray_tpu._private.serialization import loads_trusted
 
-    return cloudpickle.loads(params_blob)
+    return loads_trusted(params_blob)
 
 
 class PrefillWorker:

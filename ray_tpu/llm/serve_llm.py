@@ -124,6 +124,35 @@ class LLMServer:
         self.engine.params = tree
         return {"version": ver, "model_id": self.config.model_id}
 
+    async def save_engine_state(self, path: str, *, step: int = 0) -> dict:
+        """Checkpoint the engine params through the checkpoint plane
+        (``ray_tpu/ckpt``): ``path`` becomes a manifest + chunk store, so
+        rolling saves across replicas dedup identical params to the same
+        chunks. Runs off-loop — in-flight requests keep decoding."""
+        loop = asyncio.get_event_loop()
+
+        def _save():
+            from ray_tpu.llm.engine import save_params
+
+            return save_params(self.engine.params, path, step=step)
+
+        manifest_path = await loop.run_in_executor(None, _save)
+        return {"manifest": manifest_path, "model_id": self.config.model_id}
+
+    async def load_engine_state(self, path: str) -> dict:
+        """Swap engine params from a checkpoint-plane store (or legacy
+        msgpack dir); the swap is one attribute assignment between steps,
+        like ``update_weights``."""
+        loop = asyncio.get_event_loop()
+
+        def _load():
+            from ray_tpu.llm.engine import _load_params
+
+            return _load_params(path)
+
+        self.engine.params = await loop.run_in_executor(None, _load)
+        return {"model_id": self.config.model_id, "source": path}
+
     def engine_metrics(self) -> dict:
         return dict(self.engine.metrics)
 

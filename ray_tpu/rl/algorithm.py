@@ -8,7 +8,6 @@ checkpointing, and Tune-compatibility (an Algorithm is a valid trainable:
 from __future__ import annotations
 
 import os
-import pickle
 from dataclasses import asdict, dataclass
 from typing import Any, Dict, Optional
 
@@ -55,17 +54,33 @@ class Algorithm:
         raise NotImplementedError
 
     def save_checkpoint(self, checkpoint_dir: str) -> str:
-        os.makedirs(checkpoint_dir, exist_ok=True)
-        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
-        with open(path, "wb") as f:
-            pickle.dump({"iteration": self.iteration,
-                         "state": self.get_state()}, f)
+        """Commit the algorithm state through the checkpoint plane
+        (``ray_tpu/ckpt``): the dir becomes a manifest + content-addressed
+        chunk store, so repeated saves of a mostly-unchanged state (frozen
+        nets, slowly-mutating buffers) write only the delta and a torn
+        save never becomes visible."""
+        from ray_tpu.ckpt import CheckpointStore, save_checkpoint
+
+        store = CheckpointStore(checkpoint_dir, name="rl")
+        save_checkpoint(store, {"iteration": self.iteration,
+                                "state": self.get_state()},
+                        step=self.iteration)
         return checkpoint_dir
 
     def restore_from_checkpoint(self, checkpoint_dir: str) -> None:
-        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
-        with open(path, "rb") as f:
-            blob = pickle.load(f)
+        from ray_tpu.ckpt import CheckpointStore, restore_tree
+
+        store = CheckpointStore(checkpoint_dir, name="rl")
+        if store.latest_id() is not None:
+            blob = restore_tree(store)
+        else:
+            # pre-plane checkpoint layout: a single pickled state blob,
+            # decoded only through the audited boundary (raylint SER001)
+            from ray_tpu._private.serialization import loads_trusted
+
+            path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+            with open(path, "rb") as f:
+                blob = loads_trusted(f.read())
         self.iteration = blob["iteration"]
         self.set_state(blob["state"])
 

@@ -171,6 +171,44 @@ def cmd_tasks(args):
               f"[{t['state']}] attempt={t['attempt']} {transitions}{err}")
 
 
+def cmd_ckpt(args):
+    """ray-tpu ckpt: inspect checkpoint-plane stores (ray_tpu/ckpt/).
+
+    With ``--root`` the subcommands operate directly on a store directory
+    (no cluster needed); without it, ``list`` shows every store registered
+    with the GCS (KV ns ``ckpt``)."""
+    if not args.root:
+        _connect(args)
+        from ray_tpu.util.state import list_checkpoints
+
+        print(json.dumps(list_checkpoints(), indent=2, default=str))
+        return
+    from ray_tpu.ckpt import CheckpointStore, diff_manifests
+
+    store = CheckpointStore(args.root)
+    if args.ckpt_command == "list":
+        rows = store.stats()
+        print(json.dumps(rows, indent=2, default=str))
+    elif args.ckpt_command == "inspect":
+        man = store.read(args.ckpt_id) if args.ckpt_id else store.latest()
+        if man is None:
+            print("no committed checkpoint", file=sys.stderr)
+            sys.exit(1)
+        out = man.to_json()
+        if not args.chunks:
+            # per-leaf chunk lists are the bulk of a big manifest; show
+            # counts unless asked
+            out["leaves"] = {
+                k: {"kind": v["kind"], "shape": v["shape"],
+                    "dtype": v["dtype"], "num_chunks": len(v["chunks"])}
+                for k, v in out["leaves"].items()}
+        print(json.dumps(out, indent=2, default=str))
+    elif args.ckpt_command == "diff":
+        print(json.dumps(diff_manifests(store.read(args.a),
+                                        store.read(args.b)),
+                         indent=2, default=str))
+
+
 def cmd_microbenchmark(args):
     import ray_tpu
 
@@ -265,6 +303,23 @@ def main(argv=None):
     p.add_argument("--state", default="", help="filter by lifecycle state")
     p.add_argument("--limit", type=int, default=100)
     p.set_defaults(fn=cmd_tasks)
+
+    p = sub.add_parser("ckpt", help="checkpoint-plane stores "
+                                    "(list/inspect/diff)")
+    csub = p.add_subparsers(dest="ckpt_command", required=False)
+    cl = csub.add_parser("list", help="store summary (all registered "
+                                      "stores without --root)")
+    cl.add_argument("--root", default="", help="store directory")
+    ci = csub.add_parser("inspect", help="one manifest (default: latest)")
+    ci.add_argument("--root", required=True)
+    ci.add_argument("ckpt_id", nargs="?", default="")
+    ci.add_argument("--chunks", action="store_true",
+                    help="include full per-leaf chunk lists")
+    cd = csub.add_parser("diff", help="chunk delta between two manifests")
+    cd.add_argument("--root", required=True)
+    cd.add_argument("a")
+    cd.add_argument("b")
+    p.set_defaults(fn=cmd_ckpt, ckpt_command="list", root="")
 
     p = sub.add_parser("microbenchmark", help="run the core perf suite")
     p.add_argument("--duration", type=float, default=2.0)

@@ -1,10 +1,16 @@
-"""Checkpoints + top-K retention.
+"""Train checkpoints: directory handles over the checkpoint plane.
 
 Reference: ray.train.Checkpoint (directory handle) and ``CheckpointManager``
-(train/v2/_internal/execution/checkpoint/checkpoint_manager.py:71) persisting
-through a storage context (execution/storage.py:312). Round 1 storage is a
-filesystem path (local or NFS/gcsfuse mount); orbax handles the array state
-inside the directory (see ray_tpu/train/orbax_utils.py).
+(train/v2/_internal/execution/checkpoint/checkpoint_manager.py:71).
+
+Since PR 4 the manager is a thin policy layer over ``ray_tpu/ckpt/`` — the
+single checkpoint backend: a reported checkpoint directory is snapshotted
+as a tree of file-bytes leaves and committed as an immutable manifest +
+content-addressed chunks (``<run_dir>/ckpts/``). Consecutive checkpoints
+whose files did not change dedup to the same chunks, a torn save is never
+visible, and ``latest()/best()`` materialize a directory back out of the
+manifest on demand. There is no whole-tree pickle (or ``copytree``) save
+path left here.
 """
 
 from __future__ import annotations
@@ -38,9 +44,53 @@ class Checkpoint:
         return f"Checkpoint({self.path})"
 
 
+# ---------------------------------------------------------------------------
+# directory <-> tree codec (files as uint8 leaves on the ckpt plane)
+# ---------------------------------------------------------------------------
+
+
+def dir_to_tree(path: str) -> Dict[str, Any]:
+    """A checkpoint directory as a flat ``{relpath: uint8 array}`` tree —
+    the shape the checkpoint plane stores. File bytes are read into RAM
+    here (the snapshot barrier), so the source directory may be deleted
+    the moment this returns."""
+    import numpy as np
+
+    out: Dict[str, Any] = {}
+    root = os.path.abspath(path)
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root)
+            with open(full, "rb") as f:
+                out[rel] = np.frombuffer(f.read(), dtype=np.uint8)
+    return out
+
+
+def tree_to_dir(tree: Dict[str, Any], dest: str) -> str:
+    """Materialize a file tree restored from a manifest back into a
+    directory (each file lands atomically)."""
+    from ray_tpu.ckpt.manifest import atomic_write
+
+    os.makedirs(dest, exist_ok=True)
+    for rel, data in tree.items():
+        atomic_write(os.path.join(dest, rel), bytes(memoryview(data)))
+    return dest
+
+
+def checkpoint_store(run_dir: str):
+    """The run's checkpoint-plane store (shared by workers + controller)."""
+    from ray_tpu.ckpt import CheckpointStore
+
+    return CheckpointStore(os.path.join(run_dir, "ckpts"),
+                           name=os.path.basename(os.path.abspath(run_dir))
+                           or "train")
+
+
 class CheckpointManager:
-    """Tracks reported checkpoints under <storage>/<run>/checkpoint_NNNNNN,
-    keeps top-K by the configured score attribute."""
+    """Tracks reported checkpoints, keeps top-K by the configured score
+    attribute. Storage is the checkpoint plane; records reference manifest
+    ids and directories are materialized lazily on access."""
 
     def __init__(self, run_dir: str, num_to_keep: Optional[int] = None,
                  score_attribute: Optional[str] = None, score_order: str = "max"):
@@ -50,7 +100,11 @@ class CheckpointManager:
         self.score_order = score_order
         self.index = 0
         self.records: List[Dict[str, Any]] = []
+        # manifests that never committed (saver crashed mid-write): cached
+        # so every latest() after the first does not re-pay the wait
+        self._failed_ids: set = set()
         os.makedirs(run_dir, exist_ok=True)
+        self.store = checkpoint_store(run_dir)
         self._load_state()
 
     def _state_path(self) -> str:
@@ -61,51 +115,137 @@ class CheckpointManager:
             with open(self._state_path()) as f:
                 state = json.load(f)
             self.index = state["index"]
-            self.records = state["records"]
+            self.records = [self._migrate_record(i, r)
+                            for i, r in enumerate(state["records"])]
         except (FileNotFoundError, json.JSONDecodeError, KeyError):
             pass
 
+    @staticmethod
+    def _migrate_record(i: int, rec: Dict[str, Any]) -> Dict[str, Any]:
+        """Accept pre-plane records ({"path": dir, ...}): they have no
+        manifest (ckpt_id None) and resolve straight to their directory —
+        a run started on the previous layout resumes instead of crashing."""
+        if "ckpt_id" in rec:
+            return rec
+        path = rec.get("path", "")
+        try:
+            index = int(os.path.basename(path).rsplit("_", 1)[-1])
+        except (ValueError, IndexError):
+            index = i + 1
+        return {"ckpt_id": None, "index": index, "path": path,
+                "metrics": rec.get("metrics", {}), "time": rec.get("time", 0)}
+
     def _save_state(self):
-        with open(self._state_path(), "w") as f:
-            json.dump({"index": self.index, "records": self.records}, f)
+        from ray_tpu.ckpt.manifest import atomic_write
+
+        atomic_write(self._state_path(),
+                     json.dumps({"index": self.index,
+                                 "records": self.records}).encode())
+
+    # -- registration --------------------------------------------------
 
     def register(self, source_dir: str, metrics: Dict[str, Any]) -> Checkpoint:
-        """Persist a worker-reported checkpoint directory into the run dir."""
+        """Persist a reported checkpoint directory through the plane
+        (blocking — used by callers that hand over a directory they are
+        about to delete)."""
+        from ray_tpu.ckpt import save_checkpoint
+
+        manifest = save_checkpoint(self.store, dir_to_tree(source_dir),
+                                   step=self.index + 1, metrics=metrics)
+        return self.register_manifest(manifest.ckpt_id, metrics)
+
+    def register_manifest(self, ckpt_id: str,
+                          metrics: Dict[str, Any]) -> Checkpoint:
+        """Record an already-saved (possibly still committing) checkpoint
+        manifest — the worker-side async save path."""
         self.index += 1
-        dest = os.path.join(self.run_dir, f"checkpoint_{self.index:06d}")
-        if os.path.abspath(source_dir) != dest:
-            shutil.copytree(source_dir, dest, dirs_exist_ok=True)
-        self.records.append({"path": dest, "metrics": metrics, "time": time.time()})
+        self.records.append({"ckpt_id": ckpt_id, "index": self.index,
+                             "metrics": metrics, "time": time.time()})
         self._prune()
         self._save_state()
-        return Checkpoint(dest)
+        return Checkpoint(self._dir_for(self.records[-1]))
+
+    # -- retention -----------------------------------------------------
+
+    def _ranked(self) -> List[Dict[str, Any]]:
+        if not self.score_attribute:
+            return list(self.records)
+        sign = 1 if self.score_order == "max" else -1
+        return sorted(
+            self.records,
+            key=lambda r: sign * float(
+                r["metrics"].get(self.score_attribute, 0.0)),
+            reverse=True)
 
     def _prune(self):
         if self.num_to_keep is None or len(self.records) <= self.num_to_keep:
             return
         if self.score_attribute:
-            sign = 1 if self.score_order == "max" else -1
-            ranked = sorted(
-                self.records,
-                key=lambda r: sign * float(r["metrics"].get(self.score_attribute, 0.0)),
-                reverse=True)
-            keep = ranked[: self.num_to_keep]
+            keep = self._ranked()[: self.num_to_keep]
         else:
             keep = self.records[-self.num_to_keep:]
         for rec in self.records:
             if rec not in keep:
-                shutil.rmtree(rec["path"], ignore_errors=True)
+                shutil.rmtree(self._dir_for(rec), ignore_errors=True)
         self.records = [r for r in self.records if r in keep]
+        # drop the superseded manifests and GC their now-orphan chunks;
+        # the store's grace window protects chunks of a save whose
+        # manifest has not committed yet (the worker-side async path)
+        self.store.retention(keep_last=0,
+                             keep_ids=[r["ckpt_id"] for r in self.records
+                                       if r.get("ckpt_id")])
+
+    # -- access --------------------------------------------------------
+
+    def _dir_for(self, rec: Dict[str, Any]) -> str:
+        return rec.get("path") or os.path.join(
+            self.run_dir, f"checkpoint_{rec['index']:06d}")
+
+    def _materialize(self, rec: Dict[str, Any],
+                     timeout: float = 10.0) -> Optional[str]:
+        """Directory for a record, restored from its manifest on first
+        access. Returns None when the manifest never committed (saver
+        died mid-write) — callers fall back to the previous record."""
+        dest = self._dir_for(rec)
+        if os.path.isdir(dest):
+            return dest
+        if rec.get("ckpt_id") is None:  # pre-plane record, dir is gone
+            return None
+        if rec["ckpt_id"] in self._failed_ids:
+            return None
+        from ray_tpu.ckpt import restore_tree
+
+        try:
+            self.store.wait_for(rec["ckpt_id"], timeout=timeout)
+            tree = restore_tree(self.store, rec["ckpt_id"])
+        except (TimeoutError, FileNotFoundError, KeyError, ValueError):
+            # blacklist only once the record is old enough that its save
+            # can no longer be in flight — a merely-slow commit must not
+            # be skipped forever, a truly torn one must only be waited
+            # for once
+            if time.time() - rec.get("time", 0) > 60.0:
+                self._failed_ids.add(rec["ckpt_id"])
+            return None
+        return tree_to_dir(tree, dest)
 
     def latest(self) -> Optional[Checkpoint]:
-        return Checkpoint(self.records[-1]["path"]) if self.records else None
+        """Newest restorable checkpoint: records whose manifest never
+        committed (a save torn by a crash) are skipped, newest-first."""
+        for i, rec in enumerate(reversed(self.records)):
+            # only the newest record may still be mid-commit; give it a
+            # short grace window, fall straight through for older ones
+            path = self._materialize(rec, timeout=10.0 if i == 0 else 0.0)
+            if path is not None:
+                return Checkpoint(path)
+        return None
 
     def best(self) -> Optional[Checkpoint]:
         if not self.records:
             return None
         if not self.score_attribute:
             return self.latest()
-        sign = 1 if self.score_order == "max" else -1
-        rec = max(self.records,
-                  key=lambda r: sign * float(r["metrics"].get(self.score_attribute, 0.0)))
-        return Checkpoint(rec["path"])
+        for rec in self._ranked():
+            path = self._materialize(rec)
+            if path is not None:
+                return Checkpoint(path)
+        return None

@@ -62,28 +62,51 @@ def get_context() -> TrainContext:
     return ctx
 
 
+_savers: Dict[str, Any] = {}  # store root -> per-process async saver
+_savers_lock = threading.Lock()
+
+
+def _saver_for(run_dir: str):
+    from ray_tpu.ckpt import CheckpointSaver
+    from ray_tpu.train.checkpoint import checkpoint_store
+
+    store = checkpoint_store(run_dir)
+    with _savers_lock:
+        saver = _savers.get(store.root)
+        if saver is None:
+            saver = _savers[store.root] = CheckpointSaver(store)
+        return saver
+
+
 def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
     """Report metrics (all ranks) and optionally a checkpoint (rank 0 ships
-    it to storage via the controller; other ranks' checkpoints are ignored in
-    round 1 — single-writer checkpoint layout)."""
-    import shutil
-    import uuid
+    it through the checkpoint plane; other ranks' checkpoints are ignored in
+    round 1 — single-writer checkpoint layout).
 
+    The save is ASYNC: the checkpoint directory's bytes are snapshotted to
+    RAM here (so the caller may delete the directory immediately), the
+    chunk writes + manifest commit happen on a background thread, and only
+    the manifest id rides the report RPC. A second checkpointed report
+    while the previous save is still writing blocks then (backpressure),
+    never mid-step."""
     import ray_tpu
+    from ray_tpu.train.checkpoint import dir_to_tree
 
     ctx = get_context()
-    ckpt_dir = None
+    ckpt_ref = None
     if checkpoint is not None and ctx.rank == 0:
-        # stage into the (shared) run dir so the controller can adopt it even
-        # if this worker's scratch space vanishes
         run_dir = getattr(ctx, "run_dir", None)
-        src = checkpoint.as_directory()
         if run_dir:
-            ckpt_dir = f"{run_dir}/staged_{uuid.uuid4().hex[:8]}"
-            shutil.copytree(src, ckpt_dir, dirs_exist_ok=True)
+            step = int(metrics.get("step", metrics.get(
+                "training_iteration", 0)) or 0)
+            tree = dir_to_tree(checkpoint.as_directory())
+            ckpt_ref = _saver_for(run_dir).save(tree, step=step,
+                                                metrics=metrics)
         else:
-            ckpt_dir = src
-    ray_tpu.get(ctx.controller._on_report.remote(ctx.rank, metrics, ckpt_dir),
+            # no shared run dir (a bare context in unit tests): hand the
+            # directory itself over; the controller saves it blocking
+            ckpt_ref = {"dir": checkpoint.as_directory()}
+    ray_tpu.get(ctx.controller._on_report.remote(ctx.rank, metrics, ckpt_ref),
                 timeout=300)
 
 

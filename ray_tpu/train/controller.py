@@ -47,15 +47,17 @@ class TrainController:
         return True
 
     def _on_report(self, rank: int, metrics: Dict[str, Any],
-                   staged_ckpt_dir: Optional[str]) -> bool:
+                   ckpt_ref) -> bool:
+        """``ckpt_ref`` is a checkpoint-plane manifest id (the worker-side
+        async save path — chunks may still be committing when this lands),
+        a ``{"dir": path}`` fallback for bare contexts, or None."""
         with self._lock:
             if rank == 0:
                 self.latest_metrics = dict(metrics)
-            if staged_ckpt_dir:
-                self.manager.register(staged_ckpt_dir, metrics)
-                import shutil
-
-                shutil.rmtree(staged_ckpt_dir, ignore_errors=True)
+            if isinstance(ckpt_ref, str):
+                self.manager.register_manifest(ckpt_ref, metrics)
+            elif isinstance(ckpt_ref, dict) and ckpt_ref.get("dir"):
+                self.manager.register(ckpt_ref["dir"], metrics)
         return True
 
     def status(self) -> Dict[str, Any]:

@@ -8,6 +8,7 @@ failing their next report with ``TuneStopException``.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -46,6 +47,11 @@ class TuneConfig:
     scheduler: Any = None
     search_alg: Any = None  # a tune.searchers.Searcher proposing configs
     seed: int = 0
+    # checkpoint-plane store root for trial checkpoints (PBT exploit state);
+    # default: a run-scoped dir under /tmp, deleted when fit() returns.
+    # On a multi-node cluster this MUST be a path shared by every trial
+    # node (NFS/gcsfuse) — the same contract as RunConfig.storage_path
+    storage_path: Optional[str] = None
 
 
 @dataclass
@@ -91,7 +97,11 @@ class _ReportHub:
     """Collects trial reports and runs scheduler decisions centrally."""
 
     def __init__(self, scheduler_blob: bytes):
-        self.scheduler = cloudpickle.loads(scheduler_blob)
+        # driver-authored blob: decode only through the audited
+        # serialization boundary (raylint SER001)
+        from ray_tpu._private.serialization import loads_trusted
+
+        self.scheduler = loads_trusted(scheduler_blob)
         self.latest: Dict[str, Dict] = {}
         self.iters: Dict[str, int] = {}
         self.registered: set = set()
@@ -158,16 +168,20 @@ class _ReportHub:
 
 
 @ray_tpu.remote
-def _run_trial(fn_blob: bytes, config, trial_id: str, hub) -> Dict:
+def _run_trial(fn_blob: bytes, config, trial_id: str, hub,
+               ckpt_root=None) -> Dict:
     # runtime imports: the decorated function pickles by value, so it must not
     # close over module globals (the thread-local session is unpicklable)
-    import cloudpickle as _cp
+    from ray_tpu._private.serialization import loads_trusted
 
     from ray_tpu.tune import tuner as _tuner
 
-    fn = _cp.loads(fn_blob)
+    # driver-authored trainable blob: audited boundary only (raylint SER001)
+    fn = loads_trusted(fn_blob)
     _tuner._session.hub = hub
     _tuner._session.trial_id = trial_id
+    _tuner._session.ckpt_root = ckpt_root
+    config = _tuner._resolve_checkpoint_ref(config)
     try:
         out = fn(config)
         return {"metrics": out if isinstance(out, dict) else {}, "stopped": False}
@@ -180,13 +194,66 @@ def _run_trial(fn_blob: bytes, config, trial_id: str, hub) -> Dict:
         _tuner._session.hub = None
 
 
+def _resolve_checkpoint_ref(config):
+    """Rehydrate a checkpoint-plane ref in ``config["__checkpoint__"]``
+    (the shape PBT exploit hands around) back into the tree the trainable
+    expects. Plain checkpoint values pass through untouched."""
+    ref = (config or {}).get("__checkpoint__")
+    if not (isinstance(ref, dict) and "__ckpt_ref__" in ref):
+        return config
+    from ray_tpu.ckpt import CheckpointStore, restore_tree
+
+    config = dict(config)
+    try:
+        config["__checkpoint__"] = restore_tree(
+            CheckpointStore(ref["root"]), ref["__ckpt_ref__"], timeout=5.0)
+    except (TimeoutError, FileNotFoundError) as e:
+        raise RuntimeError(
+            f"trial checkpoint {ref['__ckpt_ref__']!r} is not readable "
+            f"from this node (store root {ref['root']!r}). PBT exploit "
+            f"state lives on the checkpoint plane; on a multi-node "
+            f"cluster set TuneConfig.storage_path to a path shared by "
+            f"every trial node (NFS/gcsfuse), like RunConfig.storage_path "
+            f"for train runs") from e
+    return config
+
+
+_trial_savers: Dict[str, Any] = {}  # store root -> per-process saver
+
+
+def _save_trial_checkpoint(checkpoint):
+    """Route a trial's reported checkpoint through the checkpoint plane:
+    the tree is committed to the run's store and only a tiny manifest ref
+    crosses to the hub — PBT exploit then is a manifest swap, and the
+    donor state is never re-pickled through hub -> tuner -> trial.
+    Content addressing dedups the unchanged leaves across a trial's
+    consecutive reports and across cloned trials (the store root must be
+    shared across trial nodes; see TuneConfig.storage_path)."""
+    root = getattr(_session, "ckpt_root", None)
+    if root is None or checkpoint is None or (
+            isinstance(checkpoint, dict) and "__ckpt_ref__" in checkpoint):
+        return checkpoint
+    from ray_tpu.ckpt import CheckpointSaver, CheckpointStore
+
+    saver = _trial_savers.get(root)
+    if saver is None:
+        saver = _trial_savers[root] = CheckpointSaver(CheckpointStore(root))
+    # blocking: the ref may be exploited by another trial the moment the
+    # hub sees it, so the manifest must be committed before it escapes
+    cid = saver.save(checkpoint, blocking=True)
+    return {"__ckpt_ref__": cid, "root": root}
+
+
 def report(metrics: Dict[str, Any], checkpoint=None):
     """tune.report inside a trial. Raises TuneStopException when the
     scheduler stops the trial, TuneExploitException when PBT replaces it
-    with a better trial's state."""
+    with a better trial's state. Checkpoints are saved to the run's
+    checkpoint-plane store trial-side; the hub only ever sees manifest
+    refs."""
     hub = getattr(_session, "hub", None)
     if hub is None:
         raise RuntimeError("tune.report called outside a trial")
+    checkpoint = _save_trial_checkpoint(checkpoint)
     decision = ray_tpu.get(
         hub.report.remote(_session.trial_id, metrics, checkpoint), timeout=300)
     if decision == STOP:
@@ -216,14 +283,20 @@ class Tuner:
 
             searcher = BasicVariantSearcher(self.param_space, tc.num_samples,
                                             tc.seed)
+        run_tag = uuid.uuid4().hex[:8]
         hub = _ReportHub.options(
             # every RUNNING trial may hold one hub thread at a synch
             # rendezvous; size the pool so waiters can never starve the
             # report() that would release them
-            name=f"tune_hub_{uuid.uuid4().hex[:8]}",
+            name=f"tune_hub_{run_tag}",
             max_concurrency=max(16, tc.max_concurrent_trials + 4),
         ).remote(cloudpickle.dumps(scheduler))
         fn_blob = cloudpickle.dumps(self.trainable)
+        # trial checkpoints live on the checkpoint plane for the run's
+        # lifetime; an ephemeral (default) root is deleted on completion
+        ckpt_root = tc.storage_path or os.path.join(
+            "/tmp/ray_tpu/tune_ckpts", f"run_{run_tag}")
+        ephemeral_store = tc.storage_path is None
 
         pending: List[tuple] = []
         running: Dict[Any, tuple] = {}
@@ -238,7 +311,7 @@ class Tuner:
                 num_tpus=self.resources.get("TPU", 0.0),
                 resources={k: v for k, v in self.resources.items()
                            if k not in ("CPU", "TPU")},
-            ).remote(fn_blob, cfg, trial_id, hub)
+            ).remote(fn_blob, cfg, trial_id, hub, ckpt_root)
             running[ref] = (trial_id, cfg)
 
         while True:
@@ -291,4 +364,8 @@ class Tuner:
                 searcher.on_trial_complete(
                     trial_id, {**final, "__config__": cfg_clean})
         ray_tpu.kill(hub)
+        if ephemeral_store:
+            import shutil
+
+            shutil.rmtree(ckpt_root, ignore_errors=True)
         return ResultGrid(results, tc.metric, tc.mode)

@@ -65,11 +65,14 @@ def get_task(task_id: str) -> Optional[Dict[str, Any]]:
 
 def summarize_tasks(job_id: Optional[str] = None) -> Dict[str, Any]:
     """Per-function counts by lifecycle state — the ``ray summary tasks``
-    analog. Includes the GCS-side drop counters so ring truncation is
-    visible."""
+    analog. Includes per-function object-size accounting
+    (``per_function_bytes``: summed serialized arg bytes from SUBMITTED
+    events and returned-object bytes from terminal events) and the
+    GCS-side drop counters so ring truncation is visible."""
     core = _core()
     if getattr(core, "mode", "") == "local":
-        return {"per_function": {}, "total": 0, "dropped": {}}
+        return {"per_function": {}, "per_function_bytes": {}, "total": 0,
+                "dropped": {}}
     return core._run(core._gcs_call("SummarizeTasks", {"job_id": job_id}),
                      30.0)
 
@@ -131,23 +134,36 @@ def list_dataset_stats() -> List[Dict[str, Any]]:
     return out
 
 
+def _kv_namespace_dump(ns: str) -> Dict[str, Any]:
+    """All wire-decoded values of one GCS KV namespace, keyed by KV key —
+    the shared read shape of every stats mirror (weights, ckpt, ...)."""
+    core = _core()
+    keys = core._run(core._gcs_call(
+        "KVKeys", {"ns": ns, "prefix": ""}), 30.0)["keys"]
+    out = {}
+    for k in keys:
+        blob = core._run(core._gcs_call(
+            "KVGet", {"ns": ns, "key": k}), 30.0)["value"]
+        if blob is not None:
+            out[k] = wire.loads(blob)
+    return out
+
+
 def list_weight_stores() -> Dict[str, Any]:
     """Weight-plane transfer stats per store (reference surface: the
     dashboard's /api/weights): per-version bytes published/pulled, chunk
     counts, commit timestamps — mirrored to GCS KV ns="weights" by
     WeightStoreActor (ray_tpu/weights/store.py) on every commit/pull."""
-    from ray_tpu._private import worker as worker_mod
+    return _kv_namespace_dump("weights")
 
-    core = worker_mod.global_worker()
-    keys = core._run(core._gcs_call(
-        "KVKeys", {"ns": "weights", "prefix": ""}))["keys"]
-    out = {}
-    for k in keys:
-        blob = core._run(core._gcs_call(
-            "KVGet", {"ns": "weights", "key": k}))["value"]
-        if blob is not None:
-            out[k] = wire.loads(blob)
-    return out
+
+def list_checkpoints() -> Dict[str, Any]:
+    """Checkpoint-plane stores registered with the GCS (reference surface:
+    the dashboard's /api/checkpoints): per-store latest/pinned checkpoint
+    ids, per-checkpoint step/bytes/dedup stats and retention drop
+    counters — mirrored to GCS KV ns="ckpt" by CheckpointStore
+    (ray_tpu/ckpt/store.py) on every commit/pin/retention."""
+    return _kv_namespace_dump("ckpt")
 
 
 def summarize_cluster() -> Dict[str, Any]:

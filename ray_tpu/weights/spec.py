@@ -190,7 +190,16 @@ def flatten_tree(tree: Any, _prefix: str = "") -> Tuple[Any, Dict[str, Any]]:
             return {k: walk(v, f"{prefix}{k}/") for k, v in sorted(node.items())}
         if isinstance(node, (list, tuple)):
             out = [walk(v, f"{prefix}{i}/") for i, v in enumerate(node)]
-            return out if isinstance(node, list) else ["__tuple__"] + out
+            if isinstance(node, list):
+                return out
+            if type(node) is not tuple and hasattr(node, "_fields"):
+                # a namedtuple (optimizer states are trees of these):
+                # remember the concrete class so unflatten can rebuild it
+                # instead of degrading to a plain tuple
+                cls = type(node)
+                return ["__namedtuple__",
+                        f"{cls.__module__}:{cls.__qualname__}"] + out
+            return ["__tuple__"] + out
         path = prefix.rstrip("/") or "leaf"
         if path in leaves:
             raise ValueError(f"duplicate leaf path {path!r}")
@@ -208,6 +217,18 @@ def unflatten_tree(skeleton: Any, leaves: Dict[str, Any]) -> Any:
         if isinstance(node, list):
             if node and node[0] == "__tuple__":
                 return tuple(walk(v) for v in node[1:])
+            if node and node[0] == "__namedtuple__":
+                values = [walk(v) for v in node[2:]]
+                try:
+                    import importlib
+
+                    mod, _, qual = node[1].partition(":")
+                    cls = importlib.import_module(mod)
+                    for part in qual.split("."):
+                        cls = getattr(cls, part)
+                    return cls(*values)
+                except Exception:
+                    return tuple(values)  # class gone: degrade gracefully
             return [walk(v) for v in node]
         return leaves[node]
 

@@ -609,3 +609,78 @@ def test_asy003_suppression():
             asyncio.ensure_future(self._work())  # raylint: disable=ASY003 guarded internally
     """, rules=["ASY003"])
     assert rules_of(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# CKP001 — checkpoint-plane writes outside the atomic-commit helper
+# ---------------------------------------------------------------------------
+
+
+def test_ckp001_positive_write_open_and_dump():
+    findings = lint("""
+        import json
+
+        def save_state(path, state):
+            with open(path, "w") as f:
+                json.dump(state, f)
+
+        def save_blob(path, blob):
+            with open(path, mode="wb") as f:
+                f.write(blob)
+    """, relpath="ray_tpu/ckpt/foo.py", rules=["CKP001"])
+    assert rules_of(findings) == ["CKP001"] * 3
+    assert "atomic_write" in findings[0].message
+
+
+def test_ckp001_positive_pathlib_and_train_manager():
+    findings = lint("""
+        from pathlib import Path
+
+        def save(p, data):
+            Path(p).write_bytes(data)
+    """, relpath="ray_tpu/train/checkpoint.py", rules=["CKP001"])
+    assert rules_of(findings) == ["CKP001"]
+
+
+def test_ckp001_negative_reads_helper_and_other_paths():
+    # read-mode opens on plane paths are fine
+    findings = lint("""
+        import json
+
+        def load(path):
+            with open(path) as f:
+                return json.load(f)
+
+        def load_bytes(path):
+            with open(path, "rb") as f:
+                return f.read()
+    """, relpath="ray_tpu/ckpt/foo.py", rules=["CKP001"])
+    assert rules_of(findings) == []
+    # the helper itself carries the one sanctioned raw write (suppressed)
+    findings = lint("""
+        import os
+
+        def atomic_write(path, data):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:  # raylint: disable=CKP001 this IS the helper
+                f.write(data)
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+    """, relpath="ray_tpu/ckpt/manifest.py", rules=["CKP001"])
+    assert rules_of(findings) == []
+    # writes OUTSIDE checkpoint-plane paths are not this rule's business
+    findings = lint("""
+        def log(path, line):
+            with open(path, "a") as f:
+                f.write(line)
+    """, relpath="ray_tpu/_private/logs.py", rules=["CKP001"])
+    assert rules_of(findings) == []
+
+
+def test_ckp001_nonconstant_mode_is_conservative():
+    findings = lint("""
+        def copy(path, mode):
+            with open(path, mode) as f:
+                return f
+    """, relpath="ray_tpu/ckpt/foo.py", rules=["CKP001"])
+    assert rules_of(findings) == ["CKP001"]

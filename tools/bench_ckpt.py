@@ -1,0 +1,185 @@
+"""Checkpoint-plane microbenchmark (``python -m tools.bench_ckpt``).
+
+Measures what the checkpoint plane costs and saves, so future rounds can
+hold the line on "a save never stalls the step":
+
+* ``blocking_save_ms``       — synchronous save of the benchmark state
+* ``async_pause_ms``         — the step-side pause of an async save
+                               (snapshot only; writes happen off-thread)
+* ``step_overhead_pct_*``    — simulated train-loop slowdown vs the
+                               no-checkpoint baseline, blocking vs async
+* ``dedup_ratio``            — chunk bytes reused when re-saving a state
+                               with only 1/8 of its leaves changed
+* ``incremental_save_ms``    — wall time of that mostly-deduped save
+* ``restore_mb_s``           — full-tree restore throughput
+* ``shard_restore_mb_s``     — per-host sharded restore throughput (4->2
+                               reshard through the planner)
+
+Emits one JSON object on stdout (plus --out FILE) so BENCH rounds can
+track regressions. No cluster needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import tempfile
+import time
+
+
+def _state(num_leaves: int, leaf_elems: int):
+    import numpy as np
+
+    # content-distinct leaves: content addressing dedups identical bytes
+    # ACROSS leaves too, which would make an all-zeros benchmark state
+    # report a fantasy dedup ratio
+    return {f"layer{i:02d}": {
+        "w": np.arange(leaf_elems, dtype=np.float32) * 0.37 + i,
+        "b": np.arange(leaf_elems // 64, dtype=np.float32) * (i + 1),
+    } for i in range(num_leaves)}
+
+
+def _mb(tree) -> float:
+    import numpy as np
+
+    total = 0
+    for sub in tree.values():
+        for arr in sub.values():
+            total += np.asarray(arr).nbytes
+    return total / 1e6
+
+
+def bench_saves(root: str, state, steps: int = 4, step_s: float = 0.1):
+    """Simulated train loop: baseline / blocking saves / async saves."""
+    from ray_tpu import ckpt
+
+    def loop(save_fn):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            for sub in state.values():
+                sub["w"] += 1.0  # full mutation: dedup cannot help
+            time.sleep(step_s)
+            if save_fn:
+                save_fn(i)
+        return time.perf_counter() - t0
+
+    baseline_s = loop(None)
+
+    bstore = ckpt.CheckpointStore(f"{root}/blocking")
+    tb = []
+
+    def _blocking(i):
+        t = time.perf_counter()
+        ckpt.save_checkpoint(bstore, state, step=i)
+        tb.append(time.perf_counter() - t)
+
+    blocking_s = loop(_blocking)
+
+    astore = ckpt.CheckpointStore(f"{root}/async")
+    saver = ckpt.CheckpointSaver(astore)
+    ta = []
+
+    def _async(i):
+        t = time.perf_counter()
+        saver.save(state, step=i)
+        ta.append(time.perf_counter() - t)
+
+    async_s = loop(_async)
+    saver.wait()
+    return {
+        "state_mb": round(_mb(state), 2),
+        "steps": steps,
+        "blocking_save_ms": round(1e3 * sorted(tb)[len(tb) // 2], 3),
+        "async_pause_ms": round(1e3 * sorted(ta)[len(ta) // 2], 3),
+        "step_overhead_pct_blocking": round(
+            100.0 * (blocking_s - baseline_s) / baseline_s, 1),
+        "step_overhead_pct_async": round(
+            100.0 * (async_s - baseline_s) / baseline_s, 1),
+    }
+
+
+def bench_dedup(root: str, state):
+    from ray_tpu import ckpt
+
+    store = ckpt.CheckpointStore(f"{root}/dedup")
+    ckpt.save_checkpoint(store, state, step=1)
+    # touch 1/8 of the layers (a fractional delta no other layer's content
+    # collides with); the rest dedups to existing chunks
+    keys = sorted(state)
+    for k in keys[: max(1, len(keys) // 8)]:
+        state[k]["w"] += 0.25
+    t0 = time.perf_counter()
+    manifest = ckpt.save_checkpoint(store, state, step=2)
+    dt = time.perf_counter() - t0
+    return {
+        "incremental_save_ms": round(1e3 * dt, 3),
+        "dedup_ratio": round(manifest.stats["dedup_ratio"], 4),
+        "bytes_written": manifest.stats["bytes_written"],
+        "bytes_reused": manifest.stats["bytes_reused"],
+    }
+
+
+def bench_restore(root: str, state):
+    from ray_tpu import ckpt
+    from ray_tpu.train.scaling_policy import mesh_spec_for
+    from ray_tpu.weights.spec import ShardedTreeSpec
+
+    store = ckpt.CheckpointStore(f"{root}/restore")
+    manifest = ckpt.save_checkpoint(store, state, step=1)
+    t0 = time.perf_counter()
+    tree = ckpt.restore_tree(store)
+    full_s = time.perf_counter() - t0
+    mb = _mb(tree)
+
+    # sharded flavor: save dim-0-sharded over 4 ranks, restore rank 0 of 2
+    import numpy as np
+
+    flat = {f"{k}/w": np.tile(sub["w"], (8, 1)) for k, sub in state.items()}
+    spec4 = ShardedTreeSpec(
+        mesh=mesh_spec_for(4),
+        parts={p: ("data", None) for p in flat},
+        meta={p: (a.shape, a.dtype.str) for p, a in flat.items()})
+    m2 = ckpt.save_checkpoint(store, flat, step=2, spec=spec4)
+    dst = ShardedTreeSpec(
+        mesh=mesh_spec_for(2),
+        parts={p: ("data", None) for p in flat},
+        meta=dict(spec4.meta))
+    t0 = time.perf_counter()
+    _shards, stats = ckpt.restore_shards(store, dst, "rank0", m2.ckpt_id)
+    shard_s = time.perf_counter() - t0
+    return {
+        "restore_mb": round(mb, 2),
+        "restore_mb_s": round(mb / full_s, 1),
+        "shard_restore_mb_s": round(stats["bytes_read"] / 1e6 / shard_s, 1),
+        "shard_no_gather": stats["no_gather"],
+        "manifest_chunks": len(manifest.chunk_set()),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="")
+    parser.add_argument("--leaves", type=int, default=16)
+    parser.add_argument("--leaf-elems", type=int, default=1 << 17)
+    parser.add_argument("--steps", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        out = {"bench": "ckpt"}
+        out.update(bench_saves(root, _state(args.leaves, args.leaf_elems),
+                               steps=args.steps))
+        out.update(bench_dedup(root, _state(args.leaves, args.leaf_elems)))
+        out.update(bench_restore(root, _state(args.leaves, args.leaf_elems)))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps(out, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+    return out
+
+
+if __name__ == "__main__":
+    main()

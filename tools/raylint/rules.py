@@ -604,3 +604,80 @@ class UnregisteredWireStruct(Rule):
                     f"wire.py (_register_builtin_types); register it, or mark "
                     f"it process-local with `# raylint: disable=WIRE001 <why>`"))
         return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# CKP001 — checkpoint-plane writes outside the atomic-commit helper
+# ---------------------------------------------------------------------------
+
+# Modules whose on-disk artifacts carry the checkpoint plane's atomicity
+# invariant: a torn manifest/chunk/pointer write corrupts restore. Every
+# file write there must go through ``ckpt.manifest.atomic_write`` (write
+# temp + fsync + rename) — the one sanctioned raw-write site, which
+# carries its own suppression.
+_CKP_PATH_PREFIXES = ("ray_tpu/ckpt/",)
+_CKP_PATH_FILES = {"ray_tpu/train/checkpoint.py"}
+
+# attribute calls that write file content directly
+_CKP_WRITE_ATTRS = ("write_text", "write_bytes")
+
+# dotted calls that serialize straight into a file object
+_CKP_DUMP_CALLS = {"json.dump", "pickle.dump", "cloudpickle.dump",
+                   "numpy.save", "np.save"}
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    """True if this ``open(...)`` call names a write/append/create mode.
+    A non-constant mode is treated as a write (the caller can suppress
+    with a reason if it provably is not)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default "r"
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return any(c in mode.value for c in "wax+")
+    return True
+
+
+@register_rule
+class CheckpointWriteOutsideHelper(Rule):
+    name = "CKP001"
+    summary = ("checkpoint/manifest file write outside "
+               "ckpt.manifest.atomic_write: a torn write breaks the plane's "
+               "atomicity invariant (a reader may observe a partial file)")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not (module.path.startswith(_CKP_PATH_PREFIXES)
+                or module.path in _CKP_PATH_FILES):
+            return iter(())
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = module.resolver.dotted(node.func)
+            if dotted in ("open", "io.open", "builtins.open"):
+                if _open_write_mode(node):
+                    findings.append(self.finding(
+                        module, node,
+                        "file opened for writing on a checkpoint-plane "
+                        "path; route the bytes through "
+                        "`ckpt.manifest.atomic_write` so a crash can "
+                        "never leave a torn manifest/chunk visible"))
+            elif dotted in _CKP_DUMP_CALLS:
+                findings.append(self.finding(
+                    module, node,
+                    f"`{dotted}(...)` serializes straight into a file on "
+                    f"a checkpoint-plane path; serialize to bytes and "
+                    f"commit via `ckpt.manifest.atomic_write`"))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _CKP_WRITE_ATTRS):
+                findings.append(self.finding(
+                    module, node,
+                    f"`.{node.func.attr}(...)` writes file content "
+                    f"directly on a checkpoint-plane path; use "
+                    f"`ckpt.manifest.atomic_write`"))
+        return iter(findings)
