@@ -123,6 +123,21 @@ _d("actor_max_restarts", 0)
 _d("max_pending_lease_requests", 16)
 _d("worker_startup_concurrency", 2)  # concurrent cold worker spawns per node
 _d("prestart_workers", 2)  # idle workers spawned at raylet start
+
+# --- worker provisioning plane (reference: worker_pool.h prestart/adoption) ---
+# zygote prefork pool: a per-raylet zygote process pre-imports the heavy
+# stack once and forks ready workers on demand; lease grants ADOPT a warm
+# worker instead of paying a cold interpreter+import start-up
+_d("worker_zygote_enabled", True)
+_d("zygote_preimport_jax", False)  # pre-import jax in the zygote (threads!)
+_d("zygote_fork_timeout_s", 20.0)
+# warm default-runtime-env workers the replenish loop keeps forked AND
+# registered so a lease grant is pure adoption (0 disables replenish; the
+# one-shot prestart above still applies)
+_d("worker_pool_warm_target", 2)
+# multi-grant leases: one RequestWorkerLease can return up to this many
+# grants when the owner asks (count=N) and warm workers are available
+_d("lease_max_grants", 8)
 _d("max_lineage_bytes", 64 * 1024**2)
 # ownership-based distributed refcounting (reference: reference_counter.h:44)
 _d("distributed_refcounting", 1)
@@ -141,6 +156,10 @@ _d("task_events_flush_interval_s", 1.0)
 _d("metrics_flush_interval_s", 10.0)
 _d("gcs_task_events_max_per_job", 4096)  # per-job ring; drop-oldest beyond
 _d("task_events_max_per_task", 64)  # transition entries kept per task
+# sharded/pipelined GCS task-event ingestion: AddTaskEvents enqueues by
+# task-id hash and returns; per-shard drain tasks merge in the background
+_d("gcs_task_event_shards", 8)
+_d("gcs_task_event_ingest_max", 65536)  # queued events per shard; drop beyond
 
 # --- train / libs ---
 _d("train_health_check_period_s", 1.0)

@@ -60,8 +60,23 @@ logger = logging.getLogger("ray_tpu.worker")
 
 _LEASE_IDLE_S = 2.0
 
-# cluster-unique metrics key tag (pids collide across nodes/restarts)
-_obs_proc_tag = uuid.uuid4().hex[:10]
+# cluster-unique metrics key tag (pids collide across nodes/restarts).
+# Computed lazily AND per-pid: zygote-forked workers inherit this module
+# already imported, so an import-time constant would make every forked
+# worker publish to the same KV key, clobbering each other's metrics.
+# Lock-guarded: the auto-flush loop and a manual publish_metrics() can race
+# the first computation, and two tags for one process double-counts it.
+_obs_proc_tag_cache: Optional[Tuple[int, str]] = None
+_obs_proc_tag_lock = threading.Lock()
+
+
+def _obs_proc_tag() -> str:
+    global _obs_proc_tag_cache
+    with _obs_proc_tag_lock:
+        if _obs_proc_tag_cache is None \
+                or _obs_proc_tag_cache[0] != os.getpid():
+            _obs_proc_tag_cache = (os.getpid(), uuid.uuid4().hex[:10])
+        return _obs_proc_tag_cache[1]
 
 _LATENCY_BOUNDS = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0]
 
@@ -142,6 +157,14 @@ class _LeasePool:
         self.active_leases = 0  # pushers currently holding a granted lease
         self.busy = 0  # pushers blocked inside a PushTaskBatch round trip
         self._work = asyncio.Event()  # set while pending is non-empty
+        # owner-side lease cache: extra grants from a batched
+        # RequestWorkerLease reply, consumed by sibling pushers without
+        # another raylet round trip (dropped when the queue drains)
+        self.spare_grants = deque()
+        # grants currently being asked for by in-flight lease RPCs: without
+        # this, N concurrent pushers each request the full batch for the
+        # same queue and the raylet over-grants N-fold
+        self.requesting = 0
 
     def submit(self, record: dict):
         record.setdefault("_done", asyncio.Event())
@@ -250,6 +273,35 @@ class _LeasePool:
             if self.pending:
                 self._work.set()
                 self._ensure_pushers()
+            elif self.pushers == 0:
+                self._drop_spares()
+
+    def _drop_spares(self):
+        """Return cached-but-unused grants to their raylets (the queue
+        drained before any pusher needed them)."""
+        while self.spare_grants:
+            spawn(self.core._drop_lease(self.spare_grants.popleft()),
+                  what="spare-lease return")
+
+    def _desired_count(self) -> int:
+        """How many leases this request should ask for in one round trip:
+        enough pushers to drain the queue a batch each, minus grants
+        already held, cached, or being requested by sibling pushers,
+        capped by the raylet's multi-grant bound."""
+        want = -(-len(self.pending) // self.BATCH)
+        want = min(want, RAY_CONFIG.max_pending_lease_requests)
+        have = self.active_leases + len(self.spare_grants) + self.requesting
+        return max(1, min(RAY_CONFIG.lease_max_grants, want - have))
+
+    def _stash_extras(self, reply: dict, raylet_address: str):
+        for g in reply.get("extra_grants") or ():
+            self.spare_grants.append({
+                "key": self.key, "lease_id": g["lease_id"],
+                "worker_address": g["worker_address"],
+                "raylet_address": raylet_address,
+                "last_used": time.monotonic()})
+        if self.spare_grants and self.pending:
+            self._ensure_pushers()
 
     async def _push_batch(self, lease: dict, batch: List[dict]) -> bool:
         """Ship a batch to the leased worker. Returns False if the lease
@@ -380,6 +432,10 @@ class _LeasePool:
         grants or redirects via its synced resource view — no GCS round
         trip. PG- and strategy-pinned leases, and the infeasible fallback
         (which records autoscaler demand), resolve through GCS PickNode."""
+        if self.spare_grants:
+            # owner-side lease cache: a sibling's batched request already
+            # granted a worker for this key — adopt it, zero round trips
+            return self.spare_grants.popleft()
         opts, resources = self.opts, self.resources
         req = {
             "resources": resources,
@@ -421,10 +477,15 @@ class _LeasePool:
                 # phantom autoscaler demand for work that no longer exists
                 return None
             try:
-                reply = wire.loads(await raylet.call(
-                    "RequestWorkerLease", wire.dumps(req),
-                    timeout=RAY_CONFIG.worker_start_timeout_s + 30,
-                    connect_timeout=5.0, retries=1))
+                req["count"] = n = self._desired_count()
+                self.requesting += n
+                try:
+                    reply = wire.loads(await raylet.call(
+                        "RequestWorkerLease", wire.dumps(req),
+                        timeout=RAY_CONFIG.worker_start_timeout_s + 30,
+                        connect_timeout=5.0, retries=1))
+                finally:
+                    self.requesting -= n
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 # raylet unreachable (node died between pick and lease):
                 # re-pick a node until the GCS view catches up
@@ -444,6 +505,7 @@ class _LeasePool:
                 raise RuntimeError(
                     f"runtime_env setup failed: {reply.get('error', '')}")
             if reply["status"] == "granted":
+                self._stash_extras(reply, node["address"])
                 return {"key": self.key, "lease_id": reply["lease_id"],
                         "worker_address": reply["worker_address"],
                         "raylet_address": node["address"],
@@ -528,11 +590,18 @@ class _LeasePool:
         while True:
             if not self.pending:
                 return None
+            if self.spare_grants:
+                return self.spare_grants.popleft()
             try:
-                reply = wire.loads(await core._raylet_client(addr).call(
-                    "RequestWorkerLease", wire.dumps(req),
-                    timeout=RAY_CONFIG.worker_start_timeout_s + 30,
-                    connect_timeout=5.0, retries=1))
+                req["count"] = n = self._desired_count()
+                self.requesting += n
+                try:
+                    reply = wire.loads(await core._raylet_client(addr).call(
+                        "RequestWorkerLease", wire.dumps(req),
+                        timeout=RAY_CONFIG.worker_start_timeout_s + 30,
+                        connect_timeout=5.0, retries=1))
+                finally:
+                    self.requesting -= n
             except (RpcError, asyncio.TimeoutError, OSError):
                 unreachable += 1
                 if addr != core.raylet_address:
@@ -550,6 +619,7 @@ class _LeasePool:
                 raise RuntimeError(
                     f"runtime_env setup failed: {reply.get('error', '')}")
             if status == "granted":
+                self._stash_extras(reply, addr)
                 return {"key": self.key, "lease_id": reply["lease_id"],
                         "worker_address": reply["worker_address"],
                         "raylet_address": addr,
@@ -783,7 +853,7 @@ class CoreWorker:
                    "node": self.node_hex, "metrics": snap}
         try:
             await self._gcs_call("KVPut", {
-                "ns": "metrics", "key": f"proc_{_obs_proc_tag}",
+                "ns": "metrics", "key": f"proc_{_obs_proc_tag()}",
                 "value": wire.dumps(payload)})
         except (RpcError, asyncio.TimeoutError, OSError) as e:
             logger.debug("metrics publish failed (will retry): %s", e)

@@ -274,6 +274,146 @@ class GcsTaskManager:
                 "total": total, "dropped": dict(self.dropped)}
 
 
+class ShardedTaskEvents:
+    """Sharded + pipelined front for ``GcsTaskManager``.
+
+    5k+ tasks/s of lifecycle events must not serialize on one merge path:
+    ``AddTaskEvents`` routes each event by task-id hash into one of
+    ``gcs_task_event_shards`` bounded ingest queues and returns immediately;
+    one drain task per shard merges in the background (so a burst costs the
+    caller an enqueue, not a merge), and reads fan out over the shards.
+    Per-shard rings keep the global per-job bound at
+    ``gcs_task_events_max_per_job`` in aggregate."""
+
+    def __init__(self, nshards: Optional[int] = None):
+        n = max(1, nshards or RAY_CONFIG.gcs_task_event_shards)
+        per_shard_cap = max(1, RAY_CONFIG.gcs_task_events_max_per_job // n)
+        self.shards = [GcsTaskManager(max_per_job=per_shard_cap)
+                       for _ in range(n)]
+        self._queues: List[deque] = [deque() for _ in range(n)]
+        self._wake = [asyncio.Event() for _ in range(n)]
+        self._qmax = max(256, RAY_CONFIG.gcs_task_event_ingest_max)
+        self._flush_rr = 0  # rotating start shard for bounded read flushes
+        self.ingest_dropped = 0  # queue-full drops (visible in summarize)
+        self.batches = 0  # drained merge batches (pipelining evidence)
+
+    def _shard_of(self, tid: str) -> int:
+        # task ids are hex; the tail bytes are well distributed
+        try:
+            return int(tid[-4:], 16) % len(self.shards)
+        except (ValueError, TypeError):
+            return 0
+
+    def ingest(self, events: List[dict], dropped: int = 0):
+        """Handler-side: route + enqueue, no merging on the RPC path."""
+        for ev in events:
+            tid = ev.get("task_id")
+            if not tid:
+                continue
+            i = self._shard_of(tid)
+            q = self._queues[i]
+            if len(q) >= self._qmax:
+                # drop-OLDEST, matching the store rings: the newest events
+                # carry the terminal FINISHED/FAILED transitions that must
+                # win the merge — shedding them would freeze tasks at
+                # RUNNING forever in every surface
+                q.popleft()
+                self.ingest_dropped += 1
+            q.append(ev)
+            self._wake[i].set()
+        if dropped:
+            self.shards[0].add_events([], dropped)
+
+    async def drain_loop(self, i: int):
+        """One per shard: merge queued events in batches."""
+        q, wake, shard = self._queues[i], self._wake[i], self.shards[i]
+        while True:
+            await wake.wait()
+            wake.clear()
+            while q:
+                batch = []
+                while q and len(batch) < 512:
+                    batch.append(q.popleft())
+                shard.add_events(batch)
+                self.batches += 1
+                # yield between batches: reads and other RPCs interleave
+                await asyncio.sleep(0)
+
+    def flush_sync(self, max_events: int = 20000):
+        """Read-your-writes for the read RPCs: merge what is queued, but
+        BOUNDED — under a sustained overload the queues can hold hundreds
+        of thousands of events, and merging them all inside one read
+        handler would stall the whole GCS loop (heartbeats, leases). The
+        start shard rotates per call so the budget doesn't systematically
+        favor low-index shards under overload. In the normal case the
+        drain tasks keep queues near-empty and this merges everything."""
+        budget = max_events
+        n = len(self._queues)
+        self._flush_rr = (self._flush_rr + 1) % n
+        for k in range(n):
+            if budget <= 0:
+                break
+            budget -= self.flush_shard((self._flush_rr + k) % n, budget)
+
+    def flush_shard(self, i: int, budget: int = 20000) -> int:
+        """Merge up to ``budget`` queued events of ONE shard; returns the
+        number merged (get_task only needs its task's shard current)."""
+        q = self._queues[i]
+        batch = []
+        while q and len(batch) < budget:
+            batch.append(q.popleft())
+        if batch:
+            self.shards[i].add_events(batch)
+        return len(batch)
+
+    # -- reads fan out over the shards ---------------------------------
+
+    def add_events(self, events: List[dict], dropped: int = 0):
+        """Synchronous compatibility path (bypasses the ingest queues)."""
+        for ev in events:
+            tid = ev.get("task_id")
+            if tid:
+                self.shards[self._shard_of(tid)].add_events([ev])
+        if dropped:
+            self.shards[0].add_events([], dropped)
+
+    def list_tasks(self, job_id=None, name=None, state=None,
+                   limit: int = 200) -> List[dict]:
+        out = []
+        for shard in self.shards:
+            out.extend(shard.list_tasks(job_id=job_id, name=name,
+                                        state=state, limit=limit))
+        out.sort(key=lambda r: r.get("start_ts", 0.0))
+        return out[-int(limit):]
+
+    def get_task(self, tid: str) -> Optional[dict]:
+        return self.shards[self._shard_of(tid)].get_task(tid)
+
+    def summarize(self, job_id=None) -> dict:
+        per_fn: Dict[str, Dict[str, int]] = {}
+        sizes: Dict[str, Dict[str, int]] = {}
+        dropped: Dict[str, int] = {}
+        total = 0
+        for shard in self.shards:
+            s = shard.summarize(job_id=job_id)
+            total += s["total"]
+            for fn, by_state in s["per_function"].items():
+                agg = per_fn.setdefault(fn, {})
+                for st, n in by_state.items():
+                    agg[st] = agg.get(st, 0) + n
+            for fn, sz in s["per_function_bytes"].items():
+                agg_sz = sizes.setdefault(fn, {"arg_bytes": 0, "ret_bytes": 0})
+                agg_sz["arg_bytes"] += sz["arg_bytes"]
+                agg_sz["ret_bytes"] += sz["ret_bytes"]
+            for k, v in s["dropped"].items():
+                dropped[k] = dropped.get(k, 0) + v
+        if self.ingest_dropped:
+            dropped["_ingest_queue"] = self.ingest_dropped
+        return {"per_function": per_fn, "per_function_bytes": sizes,
+                "total": total, "dropped": dropped,
+                "shards": len(self.shards), "merge_batches": self.batches}
+
+
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, persist_dir: str = ""):
         self.store = make_store(persist_dir)
@@ -309,8 +449,9 @@ class GcsServer:
         # structured event ring (reference: util/event.cc + export events
         # aggregated by the dashboard) — bounded, newest at the right
         self.events = deque(maxlen=1000)
-        # task lifecycle events (reference: gcs_task_manager.cc)
-        self.task_manager = GcsTaskManager()
+        # task lifecycle events, sharded + pipelined (reference:
+        # gcs_task_manager.cc; the sharding is ours — see ShardedTaskEvents)
+        self.task_manager = ShardedTaskEvents()
         self._background: List[asyncio.Task] = []
         self.start_time = time.time()
         self._load_init_data()
@@ -390,7 +531,12 @@ class GcsServer:
 
     async def start(self) -> str:
         addr = await self.server.start()
-        self._background.append(asyncio.ensure_future(self._health_check_loop()))
+        self._background.append(spawn(self._health_check_loop(),
+                                      what="gcs health-check loop"))
+        for i in range(len(self.task_manager.shards)):
+            self._background.append(spawn(
+                self.task_manager.drain_loop(i),
+                what=f"task-event drain shard {i}"))
         # resume interrupted scheduling work from replayed init data
         for record in self.actors.values():
             if record.state in ("PENDING_CREATION", "RESTARTING"):
@@ -570,6 +716,23 @@ class GcsServer:
     async def _rpc_KVGet(self, req, conn):
         return {"value": self.kv.get((req.get("ns", ""), req["key"]))}
 
+    async def _rpc_KVMultiPut(self, req, conn):
+        """Batched puts: N keys (possibly across namespaces) in one round
+        trip, so high-rate mirrors (metrics, pool stats, store stats) don't
+        serialize one handler dispatch per key."""
+        added = 0
+        for item in req.get("items") or ():
+            key = (item.get("ns", ""), item["key"])
+            self.kv[key] = item["value"]
+            self._persist_kv(key[0], key[1], item["value"])
+            added += 1
+        return {"added": added}
+
+    async def _rpc_KVMultiGet(self, req, conn):
+        ns = req.get("ns", "")
+        return {"values": {k: self.kv.get((ns, k))
+                           for k in req.get("keys") or ()}}
+
     async def _rpc_KVDel(self, req, conn):
         prefix = req.get("prefix", False)
         ns = req.get("ns", "")
@@ -674,19 +837,27 @@ class GcsServer:
     # -- task lifecycle events (reference: gcs_task_manager.cc RPCs) --
 
     async def _rpc_AddTaskEvents(self, req, conn):
-        self.task_manager.add_events(req.get("events") or [],
-                                     int(req.get("dropped") or 0))
+        # enqueue-and-return: the per-shard drain tasks merge in the
+        # background so a 5k tasks/s burst costs each reporter an enqueue,
+        # not a synchronous merge on the shared handler path
+        self.task_manager.ingest(req.get("events") or [],
+                                 int(req.get("dropped") or 0))
         return {"status": "ok"}
 
     async def _rpc_ListTasks(self, req, conn):
+        self.task_manager.flush_sync()  # reads see everything enqueued
         return {"tasks": self.task_manager.list_tasks(
             job_id=req.get("job_id"), name=req.get("name"),
             state=req.get("state"), limit=int(req.get("limit") or 200))}
 
     async def _rpc_GetTask(self, req, conn):
-        return {"task": self.task_manager.get_task(req["task_id"])}
+        # only the one shard this task hashes to needs to be current
+        tm = self.task_manager
+        tm.flush_shard(tm._shard_of(req["task_id"]))
+        return {"task": tm.get_task(req["task_id"])}
 
     async def _rpc_SummarizeTasks(self, req, conn):
+        self.task_manager.flush_sync()
         return self.task_manager.summarize(job_id=req.get("job_id"))
 
     async def _rpc_Subscribe(self, req, conn):
@@ -952,6 +1123,16 @@ class GcsServer:
                     return
                 continue
             try:
+                # optimistic view update: concurrent _schedule_actor loops
+                # all read node_available, which only refreshes on 1 Hz
+                # heartbeats — without this decrement a 100-actor burst
+                # herds onto ONE node and the overflow parks at its raylet
+                # for the whole worker_start_timeout while other nodes sit
+                # empty (the next heartbeat corrects any drift)
+                avail = self.node_available.get(node_id)
+                if avail is not None:
+                    for k, v in resources.items():
+                        avail[k] = avail.get(k, 0.0) - v
                 client = self.node_clients[node_id]
                 reply = wire.loads(await client.call("RequestWorkerLease", wire.dumps({
                     "resources": resources,

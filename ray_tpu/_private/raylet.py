@@ -45,6 +45,8 @@ from ray_tpu._private.async_util import spawn
 from ray_tpu._private.config import RAY_CONFIG
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.object_store import ObjectStoreServer
+from ray_tpu._private.provisioner import WorkerProvisioner
+from ray_tpu._private.provisioner.pool import _obs as _pool_obs
 from ray_tpu._private.rpc import RpcError, RpcServer, RetryingRpcClient
 
 logger = logging.getLogger("ray_tpu.raylet")
@@ -121,6 +123,9 @@ class Raylet:
         self._spawn_env["RAY_TPU_PARENT_PID"] = str(os.getpid())
         self._spawn_sem = asyncio.Semaphore(
             max(1, RAY_CONFIG.worker_startup_concurrency))
+        # provisioning plane: zygote prefork pool + warm replenishment
+        # (reference: worker_pool.h prestart/adoption)
+        self.provisioner = WorkerProvisioner(self)
         # bounded concurrent inbound pulls (reference: pull_manager.cc's
         # prioritized admission; FIFO here — all pulls are one class)
         from ray_tpu._private.pull_manager import PullQueue
@@ -149,15 +154,28 @@ class Raylet:
         )
         await self.gcs.call("RegisterNode", wire.dumps({"info": info}))
         await self._subscribe_view()
-        self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
-        self._background.append(asyncio.ensure_future(self._metrics_loop()))
-        self._background.append(asyncio.ensure_future(self._monitor_workers_loop()))
-        self._background.append(asyncio.ensure_future(self._memory_monitor_loop()))
-        self._background.append(asyncio.ensure_future(self._prestart_workers()))
-        self._background.append(asyncio.ensure_future(self._prewarm_store()))
+        # zygote boot (preimports the heavy stack) runs in the background:
+        # the raylet must register + serve immediately; fork requests wait
+        # for readiness inside the provisioner instead
+        self._background.append(spawn(self.provisioner.start(),
+                                      what="zygote start"))
+        self._background.append(spawn(self._heartbeat_loop(),
+                                      what="raylet heartbeat loop"))
+        self._background.append(spawn(self._metrics_loop(),
+                                      what="raylet metrics loop"))
+        self._background.append(spawn(self._monitor_workers_loop(),
+                                      what="worker monitor loop"))
+        self._background.append(spawn(self._memory_monitor_loop(),
+                                      what="memory monitor loop"))
+        self._background.append(spawn(self._prestart_workers(),
+                                      what="worker prestart"))
+        self._background.append(spawn(self.provisioner.replenish_loop(),
+                                      what="warm-pool replenish loop"))
+        self._background.append(spawn(self._prewarm_store(),
+                                      what="store prewarm"))
         if self.log_dir:
-            self._background.append(
-                asyncio.ensure_future(self._log_monitor_loop()))
+            self._background.append(spawn(self._log_monitor_loop(),
+                                          what="log monitor loop"))
         logger.info("raylet %s on %s resources=%s", self.node_id.hex()[:8], addr,
                     self.total_resources)
         return addr
@@ -176,6 +194,7 @@ class Raylet:
     async def stop(self):
         for t in self._background:
             t.cancel()
+        await self.provisioner.close()
         for w in list(self.workers.values()):
             try:
                 w.proc.kill()
@@ -359,6 +378,13 @@ class Raylet:
                               "objects restored from external storage (total)"),
             "loop_lag": Gauge("ray_tpu_raylet_loop_lag_seconds",
                               "raylet event-loop scheduling delay"),
+            "pool_warm": Gauge(
+                "ray_tpu_worker_pool_warm",
+                "registered default-env workers idle in the warm pool"),
+            "pool_idle": Gauge("ray_tpu_worker_pool_idle",
+                               "idle workers (any job/runtime-env)"),
+            "zygote_up": Gauge("ray_tpu_worker_pool_zygote_alive",
+                               "1 while the zygote fork server is serving"),
         }
         node_tag = {"node_id": self.node_id.hex()[:16]}
         for g in gauges.values():
@@ -379,12 +405,23 @@ class Raylet:
                 gauges["store_objects"].set(len(self.store.objects))
                 gauges["spilled"].set(self.store.num_spilled)
                 gauges["restored"].set(self.store.num_restored)
+                pool = self.provisioner.snapshot()
+                gauges["pool_warm"].set(pool["warm_default_env"])
+                gauges["pool_idle"].set(pool["idle_workers"])
+                gauges["zygote_up"].set(1.0 if pool["zygote_alive"] else 0.0)
                 payload = {"pid": os.getpid(), "time": time.time(),
                            "node": self.node_id.hex(),
                            "metrics": scrape_metrics()}
-                await self.gcs.call("KVPut", wire.dumps({
-                    "ns": "metrics", "key": key,
-                    "value": wire.dumps(payload)}), timeout=10.0, retries=0)
+                # one batched KV round trip for both namespaces (metrics +
+                # the /api/workers pool mirror)
+                await self.gcs.call("KVMultiPut", wire.dumps({"items": [
+                    {"ns": "metrics", "key": key,
+                     "value": wire.dumps(payload)},
+                    {"ns": "workers", "key": key,
+                     "value": wire.dumps({
+                         "node": self.node_id.hex(), "time": time.time(),
+                         "pool": pool})},
+                ]}), timeout=10.0, retries=0)
             except (RpcError, asyncio.TimeoutError, OSError) as e:
                 logger.debug("raylet metrics publish failed (will retry): %s", e)
             except Exception:
@@ -427,6 +464,42 @@ class Raylet:
         self.workers[w.pid] = w
         return w
 
+    async def _spawn_worker_async(self, renv: Optional[dict] = None,
+                                  renv_hash: str = "",
+                                  python_exe: Optional[str] = None
+                                  ) -> WorkerProc:
+        """Spawn-path router (reference: worker_pool StartWorkerProcess):
+        fork from the zygote when possible — the child starts with the
+        heavy stack already imported — else cold ``Popen``. pip/uv envs
+        always cold-spawn (the venv has a different interpreter)."""
+        if python_exe is None:
+            pid = await self.provisioner.fork_worker(renv)
+            if pid is not None:
+                return self._register_forked(pid, renv_hash)
+        self.provisioner.stats["cold_spawns"] += 1
+        _pool_obs()["cold"].inc()
+        return self._spawn_worker(renv, renv_hash, python_exe)
+
+    def _register_forked(self, pid: int, renv_hash: str = "") -> WorkerProc:
+        """Track a zygote-forked worker like any spawned one."""
+        from ray_tpu._private.provisioner.pool import ForkedProc
+
+        w = WorkerProc(ForkedProc(pid, self.provisioner), renv_hash)
+        self.workers[w.pid] = w
+        return w
+
+    def _scan_idle(self, job_hex: Optional[str],
+                   renv_hash: str = "") -> Optional[WorkerProc]:
+        """Non-blocking warm-pool pop: an idle worker compatible with this
+        (job, runtime-env) pair, adopted without any spawn."""
+        for i, w in enumerate(self.idle_workers):
+            if (w.job_hex is None or w.job_hex == job_hex) \
+                    and w.renv_hash == renv_hash:
+                self.idle_workers.pop(i)
+                w.job_hex = w.job_hex or job_hex
+                return w
+        return None
+
     def _log_file(self, name):
         if not self.log_dir:
             return subprocess.DEVNULL
@@ -436,24 +509,27 @@ class Raylet:
     async def _pop_worker(self, job_hex: Optional[str],
                           renv: Optional[dict] = None,
                           renv_hash: str = "") -> WorkerProc:
+        t0 = time.monotonic()
         while True:
-            for i, w in enumerate(self.idle_workers):
-                if (w.job_hex is None or w.job_hex == job_hex) \
-                        and w.renv_hash == renv_hash:
-                    self.idle_workers.pop(i)
-                    w.job_hex = w.job_hex or job_hex
-                    return w
+            w = self._scan_idle(job_hex, renv_hash)
+            if w is not None:
+                self.provisioner.stats["hits"] += 1
+                _pool_obs()["hits"].inc()
+                _pool_obs()["adoption"].observe(time.monotonic() - t0)
+                return w
             # bound concurrent spawns: each new worker pays a full
             # interpreter+import start-up; a spawn storm starves the very
             # tasks the leases are for (reference: worker_pool.h's
             # maximum_startup_concurrency)
             async with self._spawn_sem:
-                for i, w in enumerate(self.idle_workers):
-                    if (w.job_hex is None or w.job_hex == job_hex) \
-                            and w.renv_hash == renv_hash:
-                        self.idle_workers.pop(i)
-                        w.job_hex = w.job_hex or job_hex
-                        return w
+                w = self._scan_idle(job_hex, renv_hash)
+                if w is not None:
+                    self.provisioner.stats["hits"] += 1
+                    _pool_obs()["hits"].inc()
+                    _pool_obs()["adoption"].observe(time.monotonic() - t0)
+                    return w
+                self.provisioner.stats["misses"] += 1
+                _pool_obs()["misses"].inc()
                 python_exe = None
                 if renv and "pip" in renv:
                     # venv build is blocking (pip install): off the loop.
@@ -464,10 +540,11 @@ class Raylet:
 
                     python_exe = await asyncio.get_event_loop()\
                         .run_in_executor(None, ensure_env_python, renv)
-                w = self._spawn_worker(renv, renv_hash, python_exe)
+                w = await self._spawn_worker_async(renv, renv_hash, python_exe)
                 await asyncio.wait_for(w.registered,
                                        RAY_CONFIG.worker_start_timeout_s)
                 w.job_hex = job_hex
+                _pool_obs()["adoption"].observe(time.monotonic() - t0)
                 return w
 
     async def _rpc_RegisterWorker(self, req, conn):
@@ -525,11 +602,13 @@ class Raylet:
 
     async def _prestart_workers(self):
         """Warm the pool so first leases don't pay interpreter start-up
-        (reference: worker_pool prestart)."""
+        (reference: worker_pool prestart). Forks from the zygote when it is
+        up; the provisioner's replenish loop keeps the pool topped up after
+        grants drain it."""
         for _ in range(max(0, RAY_CONFIG.prestart_workers)):
             try:
                 async with self._spawn_sem:
-                    w = self._spawn_worker()
+                    w = await self._spawn_worker_async()
                     await asyncio.wait_for(
                         w.registered, RAY_CONFIG.worker_start_timeout_s)
                 w.job_hex = None
@@ -646,18 +725,33 @@ class Raylet:
                     except (asyncio.TimeoutError, Exception):
                         resources_add(pool, resources)
                         raise
-                    lease_id = uuid.uuid4().hex
-                    w.leases.add(lease_id)
-                    w.last_assigned = time.monotonic()
-                    # remember which pool to credit on release
-                    self.leases[lease_id] = (w, resources, wire.dumps((pg, bundle_index)))
-                    return {
-                        "status": "granted",
-                        "lease_id": lease_id,
-                        "worker_address": w.address,
-                        "worker_pid": w.pid,
-                        "node_id": self.node_id.hex(),
-                    }
+                    grant = self._record_grant(w, resources, pg, bundle_index)
+                    # batched multi-grant (reference: the pipelined lease
+                    # requests this amortizes in normal_task_submitter.cc):
+                    # the owner asked for up to `count` leases; extras are
+                    # granted ONLY from warm registered workers so the
+                    # reply never blocks on a spawn
+                    extras = []
+                    want = min(int(req.get("count", 1)),
+                               max(1, RAY_CONFIG.lease_max_grants))
+                    while len(extras) + 1 < want:
+                        xpool = self._lease_pool(pg, bundle_index)
+                        if xpool is None or not resources_ge(xpool, resources):
+                            break
+                        w2 = self._scan_idle(job_hex, renv_hash)
+                        if w2 is None:
+                            break
+                        resources_sub(xpool, resources)
+                        self.provisioner.stats["hits"] += 1
+                        _pool_obs()["hits"].inc()
+                        extras.append(self._record_grant(
+                            w2, resources, pg, bundle_index))
+                    _pool_obs()["grant_batch"].observe(1 + len(extras))
+                    reply = dict(grant, status="granted",
+                                 node_id=self.node_id.hex())
+                    if extras:
+                        reply["extra_grants"] = extras
+                    return reply
                 if allow_spill:
                     # busy here but a peer has capacity NOW: spill back
                     # (reference: cluster_lease_manager.cc:421)
@@ -680,6 +774,18 @@ class Raylet:
         finally:
             if parked_id is not None:
                 self._parked.pop(parked_id, None)
+
+    def _record_grant(self, w: WorkerProc, resources: Dict[str, float],
+                      pg: Optional[bytes], bundle_index: int) -> dict:
+        """Book one lease on an acquired worker (resources already debited)
+        and return its grant entry."""
+        lease_id = uuid.uuid4().hex
+        w.leases.add(lease_id)
+        w.last_assigned = time.monotonic()
+        # remember which pool to credit on release
+        self.leases[lease_id] = (w, resources, wire.dumps((pg, bundle_index)))
+        return {"lease_id": lease_id, "worker_address": w.address,
+                "worker_pid": w.pid}
 
     def _release_lease(self, lease_id: str):
         entry = self.leases.pop(lease_id, None)
@@ -764,6 +870,7 @@ class Raylet:
             "num_workers": len(self.workers),
             "num_idle": len(self.idle_workers),
             "num_leases": len(self.leases),
+            "worker_pool": self.provisioner.snapshot(),
             "store": self.store.stats(),
             "labels": dict(self.labels),
             "cluster_view_size": sum(

@@ -3,6 +3,14 @@
 Reference: python/ray/_private/workers/default_worker.py — connects the
 embedded CoreWorker to its node's raylet + the GCS, registers, then serves
 PushTask until killed.
+
+Two spawn paths share ``run_worker``:
+
+- cold: the raylet ``Popen``s ``python -m ray_tpu._private.worker_main``
+  (fresh interpreter, pays the full import cost) — ``main()`` below;
+- warm: the provisioner's zygote (``_private/provisioner/zygote.py``) forks
+  a child that calls ``run_worker`` directly — imports are already resident,
+  so start-up is fork(2) + connect.
 """
 
 from __future__ import annotations
@@ -10,9 +18,83 @@ from __future__ import annotations
 import argparse
 
 from ray_tpu._private import wire
+import os
 import signal
 import threading
 import time
+from typing import Optional
+
+
+def run_worker(raylet_address: str, gcs_address: str, node_id_hex: str,
+               log_dir: str = "", runtime_env: Optional[dict] = None,
+               orphan_ppid: Optional[int] = None) -> None:
+    """Boot the worker runtime and serve until SIGTERM (or orphaning).
+
+    ``orphan_ppid``: zygote-forked workers cannot use PDEATHSIG against the
+    raylet (their parent is the zygote, and inheriting the zygote's PDEATHSIG
+    would kill every worker on a zygote crash) — instead they watch for
+    reparenting (zygote gone). A zygote crash alone is SURVIVABLE (the
+    provisioner respawns it and this worker keeps its leases), so on
+    orphaning the worker exits only once the raylet itself stops answering
+    — the actual dead-cluster signal.
+    """
+    from ray_tpu._private.logs import setup_process_logging
+
+    setup_process_logging("worker", log_dir)
+    import faulthandler
+
+    # `kill -USR1 <pid>` dumps all thread stacks to the worker log — the
+    # ray-stack equivalent for debugging silent hangs
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.ids import NodeID
+
+    core = CoreWorker(
+        gcs_address=gcs_address,
+        raylet_address=raylet_address,
+        node_id=NodeID.from_hex(node_id_hex),
+        is_driver=False,
+    )
+    core.current_task_id = None
+    core.current_actor_id = None
+    core.connect()
+    worker_mod._global_worker = core
+
+    if runtime_env:
+        from ray_tpu._private import runtime_env as renv_mod
+
+        def kv_get(key: str):
+            return core._run(core._gcs_call(
+                "KVGet", {"ns": "renv", "key": key}))["value"]
+
+        renv_mod.apply(runtime_env, kv_get)
+
+    core._run(core.raylet.call("RegisterWorker", wire.dumps({
+        "pid": os.getpid(), "address": core.address})))
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    last_probe = 0.0
+    while not stop.is_set():
+        if orphan_ppid is not None and os.getppid() != orphan_ppid \
+                and time.monotonic() - last_probe > 5.0:
+            # reparented: the zygote died. If the raylet still answers this
+            # is a survivable zygote crash (it gets respawned); only a dead
+            # raylet means the cluster is gone and lingering would orphan us
+            last_probe = time.monotonic()
+            try:
+                core._run(core.raylet.call(
+                    "StoreStats", b"", timeout=5.0, retries=1), 15.0)
+            except Exception as e:
+                import logging
+
+                logging.getLogger("ray_tpu.worker").warning(
+                    "orphaned (zygote gone) and raylet unreachable (%s); "
+                    "exiting", e)
+                break
+        time.sleep(1.0)
 
 
 def main():
@@ -29,53 +111,15 @@ def main():
                         help="base64 JSON runtime-env descriptor")
     args = parser.parse_args()
 
-    from ray_tpu._private.logs import setup_process_logging
-
-    setup_process_logging("worker", args.log_dir)
-    import faulthandler
-
-    # `kill -USR1 <pid>` dumps all thread stacks to the worker log — the
-    # ray-stack equivalent for debugging silent hangs
-    faulthandler.register(signal.SIGUSR1, all_threads=True)
-
-    from ray_tpu._private import worker as worker_mod
-    from ray_tpu._private.core_worker import CoreWorker
-    from ray_tpu._private.ids import NodeID
-
-    core = CoreWorker(
-        gcs_address=args.gcs_address,
-        raylet_address=args.raylet_address,
-        node_id=NodeID.from_hex(args.node_id),
-        is_driver=False,
-    )
-    core.current_task_id = None
-    core.current_actor_id = None
-    core.connect()
-    worker_mod._global_worker = core
-
+    renv = None
     if args.runtime_env:
         import base64
         import json
 
-        from ray_tpu._private import runtime_env as renv_mod
-
         renv = json.loads(base64.b64decode(args.runtime_env))
 
-        def kv_get(key: str):
-            return core._run(core._gcs_call(
-                "KVGet", {"ns": "renv", "key": key}))["value"]
-
-        renv_mod.apply(renv, kv_get)
-
-    import os
-
-    core._run(core.raylet.call("RegisterWorker", wire.dumps({
-        "pid": os.getpid(), "address": core.address})))
-
-    stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    while not stop.is_set():
-        time.sleep(1.0)
+    run_worker(args.raylet_address, args.gcs_address, args.node_id,
+               log_dir=args.log_dir, runtime_env=renv)
 
 
 if __name__ == "__main__":

@@ -13,6 +13,7 @@ Endpoints:
   GET  /api/nodes|actors|pgs - tables
   GET  /api/cluster_status   - autoscaler view (demands, idle, per-node)
   GET  /api/summary          - aggregate counts
+  GET  /api/workers          - per-node worker-pool / provisioning stats
   GET  /metrics              - Prometheus text exposition
   GET  /api/jobs             - submitted jobs (job manager KV)
   POST /api/jobs             - {"entrypoint": ..., "runtime_env": ...}
@@ -87,6 +88,7 @@ class DashboardHead:
             web.get("/api/summary", self._summary),
             web.get("/api/tasks", self._tasks),
             web.get("/api/tasks/summary", self._tasks_summary),
+            web.get("/api/workers", self._workers),
             web.get("/metrics", self._prometheus),
             web.get("/api/nodes/{node_id}/stats", self._node_stats),
             web.get("/api/data_stats", self._data_stats),
@@ -175,14 +177,30 @@ class DashboardHead:
         return web.json_response(out)
 
     async def _kv_namespace_dump(self, ns: str) -> dict:
-        """All wire-decoded values of one stats-mirror KV namespace."""
+        """All wire-decoded values of one stats-mirror KV namespace
+        (one batched KVMultiGet instead of a round trip per key)."""
         keys = (await self._call("KVKeys", {"ns": ns, "prefix": ""}))["keys"]
-        out = {}
-        for k in keys:
-            blob = (await self._call("KVGet", {"ns": ns, "key": k}))["value"]
-            if blob is not None:
-                out[k] = wire.loads(blob)
-        return out
+        values = (await self._call("KVMultiGet",
+                                   {"ns": ns, "keys": keys}))["values"]
+        return {k: wire.loads(blob) for k, blob in values.items()
+                if blob is not None}
+
+    async def _workers(self, request):
+        """Per-node worker-pool stats from the provisioning plane: warm
+        pool size, zygote liveness, adoption hit/miss and fork/cold-spawn
+        counters (mirrored to the ``workers`` KV namespace by every
+        raylet's metrics loop)."""
+        from aiohttp import web
+
+        per_node = await self._kv_namespace_dump("workers")
+        totals = {"hits": 0, "misses": 0, "forks": 0, "cold_spawns": 0,
+                  "zygote_restarts": 0, "total_workers": 0,
+                  "warm_default_env": 0}
+        for entry in per_node.values():
+            pool = entry.get("pool", {})
+            for k in totals:
+                totals[k] += int(pool.get(k, 0) or 0)
+        return web.json_response({"nodes": per_node, "totals": totals})
 
     async def _weights(self, request):
         """Weight-plane stores: per-version publish/pull bytes, chunk

@@ -57,14 +57,13 @@ class _LearnerWorker:
 
     def __init__(self, factory_blob: bytes, rank: int, world_size: int,
                  group_name: str, backend: str):
-        import cloudpickle
-
         from ray_tpu import collective as col
+        from ray_tpu._private.serialization import loads_trusted
 
         if world_size > 1:
             col.init_collective_group(world_size, rank, backend=backend,
                                       group_name=group_name)
-        factory: Callable = cloudpickle.loads(factory_blob)
+        factory: Callable = loads_trusted(factory_blob)
         self.core = factory(rank=rank, world_size=world_size,
                             group_name=group_name if world_size > 1 else None)
         self.rank = rank

@@ -171,6 +171,29 @@ def cmd_tasks(args):
               f"[{t['state']}] attempt={t['attempt']} {transitions}{err}")
 
 
+def cmd_workers(args):
+    """ray-tpu workers: per-node worker-pool / provisioning-plane stats
+    (reference surface: the dashboard's /api/workers; backed by the KV
+    mirror each raylet's metrics loop publishes)."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    pools = state.list_worker_pools()
+    if args.json:
+        print(json.dumps(pools, indent=2, default=str))
+        return
+    for key, entry in sorted(pools.items()):
+        p = entry.get("pool", {})
+        zyg = "zygote=up" if p.get("zygote_alive") else (
+            "zygote=DOWN" if p.get("enabled") else "zygote=off")
+        print(f"{entry.get('node', key)[:12]} {zyg} "
+              f"warm={p.get('warm_default_env', 0)}/{p.get('warm_target', 0)} "
+              f"workers={p.get('total_workers', 0)} "
+              f"hits={p.get('hits', 0)} misses={p.get('misses', 0)} "
+              f"forks={p.get('forks', 0)} cold={p.get('cold_spawns', 0)} "
+              f"restarts={p.get('zygote_restarts', 0)}")
+
+
 def cmd_ckpt(args):
     """ray-tpu ckpt: inspect checkpoint-plane stores (ray_tpu/ckpt/).
 
@@ -303,6 +326,11 @@ def main(argv=None):
     p.add_argument("--state", default="", help="filter by lifecycle state")
     p.add_argument("--limit", type=int, default=100)
     p.set_defaults(fn=cmd_tasks)
+
+    p = sub.add_parser("workers", help="per-node worker-pool / "
+                                       "provisioning-plane stats")
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_workers)
 
     p = sub.add_parser("ckpt", help="checkpoint-plane stores "
                                     "(list/inspect/diff)")

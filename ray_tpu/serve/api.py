@@ -137,10 +137,10 @@ def deployment(target=None, *, name: Optional[str] = None, num_replicas=1,
 @ray_tpu.remote
 class _Replica:
     def __init__(self, target_blob: bytes, init_args_blob: bytes):
-        import cloudpickle as _cp
+        from ray_tpu._private.serialization import loads_trusted
 
-        target = _cp.loads(target_blob)
-        args, kwargs = _cp.loads(init_args_blob)
+        target = loads_trusted(target_blob)
+        args, kwargs = loads_trusted(init_args_blob)
         # resolve nested Applications into handles (model composition)
         args = tuple(_resolve_app_args(a) for a in args)
         kwargs = {k: _resolve_app_args(v) for k, v in kwargs.items()}
@@ -150,18 +150,22 @@ class _Replica:
             self._callable = functools.partial(target, *args, **kwargs) \
                 if args or kwargs else target
         self._num_ongoing = 0
+        # high-water mark since the autoscaler's last poll: a short burst
+        # that starts AND drains between two 0.5s samples is still load —
+        # instantaneous sampling alone is blind to it
+        self._peak_ongoing = 0
 
     async def handle_request(self, method_name: str, args_blob: bytes):
         import contextvars as _cv
 
-        import cloudpickle as _cp
-
+        from ray_tpu._private.serialization import loads_trusted
         from ray_tpu.serve.multiplex import _set_current_model_id
 
-        args, kwargs = _cp.loads(args_blob)
+        args, kwargs = loads_trusted(args_blob)
         model_id = kwargs.pop("_serve_multiplexed_model_id", "")
         token = _set_current_model_id(model_id)
         self._num_ongoing += 1
+        self._peak_ongoing = max(self._peak_ongoing, self._num_ongoing)
         try:
             if method_name == "__call__":
                 if not callable(self._callable):
@@ -193,15 +197,16 @@ class _Replica:
         proxy streaming responses)."""
         import inspect
 
-        import cloudpickle as _cp
+        from ray_tpu._private.serialization import loads_trusted
 
-        args, kwargs = _cp.loads(args_blob)
+        args, kwargs = loads_trusted(args_blob)
         kwargs.pop("_serve_multiplexed_model_id", "")
         if method_name == "__call__":
             fn = self._callable
         else:
             fn = getattr(self._callable, method_name)
         self._num_ongoing += 1
+        self._peak_ongoing = max(self._peak_ongoing, self._num_ongoing)
         try:
             if inspect.isasyncgenfunction(fn):
                 async for chunk in fn(*args, **kwargs):
@@ -225,6 +230,14 @@ class _Replica:
 
     def num_ongoing(self) -> int:
         return self._num_ongoing
+
+    def take_ongoing_peak(self) -> int:
+        """Autoscaler sample: the highest concurrent-request count since
+        the previous call (reset to the current level). Peak-based
+        sampling sees bursts that fully drain between two polls."""
+        peak = max(self._peak_ongoing, self._num_ongoing)
+        self._peak_ongoing = self._num_ongoing
+        return peak
 
     def drain(self) -> int:
         """Rolling update support: called on a replica that has been
@@ -275,9 +288,9 @@ class _ServeController:
 
     def deploy(self, name: str, target_blob: bytes, init_blob: bytes,
                cfg_blob: bytes) -> bool:
-        import cloudpickle as _cp
+        from ray_tpu._private.serialization import loads_trusted
 
-        cfg = _cp.loads(cfg_blob)
+        cfg = loads_trusted(cfg_blob)
         with self._mutate:
             old = self.apps.get(name)
             if old:
@@ -455,8 +468,12 @@ class _ServeController:
         if auto is None or not app["replicas"]:
             return
         try:
+            # peak since the last poll, not an instantaneous sample: a
+            # burst that arrives and drains entirely between two 0.5s
+            # ticks must still register as load
             ongoing = ray_tpu.get(
-                [r.num_ongoing.remote() for r in app["replicas"]], timeout=10)
+                [r.take_ongoing_peak.remote() for r in app["replicas"]],
+                timeout=10)
         except Exception:
             return
         avg = sum(ongoing) / max(len(ongoing), 1)

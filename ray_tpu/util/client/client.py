@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional
 
 import cloudpickle
 
+from ray_tpu._private.serialization import loads_trusted
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu._private.rpc import RetryingRpcClient
 from ray_tpu.exceptions import TaskError
@@ -86,7 +87,9 @@ class ClientWorker:
             self.client.call(method, pickle.dumps(req),
                              timeout=timeout or 300.0, retries=retries),
             self.loop)
-        return pickle.loads(fut.result(timeout=(timeout or 300.0) + 30))
+        # the proxy is inside the user's own trust domain; still route the
+        # unpickle through the audited boundary
+        return loads_trusted(fut.result(timeout=(timeout or 300.0) + 30))
 
     @staticmethod
     def _marker_args(args, kwargs) -> bytes:
@@ -118,8 +121,8 @@ class ClientWorker:
             "timeout": timeout,
         }, timeout=(timeout or 86400.0) + 10)
         if reply["status"] == "error":
-            raise cloudpickle.loads(reply["error"])
-        values = cloudpickle.loads(reply["blob"])
+            raise loads_trusted(reply["error"])
+        values = loads_trusted(reply["blob"])
         return values[0] if single else values
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):

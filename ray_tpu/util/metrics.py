@@ -136,5 +136,5 @@ def publish_metrics():
                "metrics": scrape_metrics()}
     # same key as the auto-flusher so the dashboard never double-counts
     core._run(core._gcs_call("KVPut", {
-        "ns": "metrics", "key": f"proc_{_obs_proc_tag}",
+        "ns": "metrics", "key": f"proc_{_obs_proc_tag()}",
         "value": wire.dumps(payload)}))

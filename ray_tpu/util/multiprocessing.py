@@ -15,9 +15,9 @@ import ray_tpu
 
 @ray_tpu.remote
 def _pool_apply(fn_blob: bytes, args, kwargs):
-    import cloudpickle
+    from ray_tpu._private.serialization import loads_trusted
 
-    fn = cloudpickle.loads(fn_blob)
+    fn = loads_trusted(fn_blob)
     return fn(*args, **(kwargs or {}))
 
 

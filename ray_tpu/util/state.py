@@ -136,17 +136,15 @@ def list_dataset_stats() -> List[Dict[str, Any]]:
 
 def _kv_namespace_dump(ns: str) -> Dict[str, Any]:
     """All wire-decoded values of one GCS KV namespace, keyed by KV key —
-    the shared read shape of every stats mirror (weights, ckpt, ...)."""
+    the shared read shape of every stats mirror (weights, ckpt, workers,
+    ...). One batched KVMultiGet, not a round trip per key."""
     core = _core()
     keys = core._run(core._gcs_call(
         "KVKeys", {"ns": ns, "prefix": ""}), 30.0)["keys"]
-    out = {}
-    for k in keys:
-        blob = core._run(core._gcs_call(
-            "KVGet", {"ns": ns, "key": k}), 30.0)["value"]
-        if blob is not None:
-            out[k] = wire.loads(blob)
-    return out
+    values = core._run(core._gcs_call(
+        "KVMultiGet", {"ns": ns, "keys": keys}), 30.0)["values"]
+    return {k: wire.loads(blob) for k, blob in values.items()
+            if blob is not None}
 
 
 def list_weight_stores() -> Dict[str, Any]:
@@ -164,6 +162,14 @@ def list_checkpoints() -> Dict[str, Any]:
     counters — mirrored to GCS KV ns="ckpt" by CheckpointStore
     (ray_tpu/ckpt/store.py) on every commit/pin/retention."""
     return _kv_namespace_dump("ckpt")
+
+
+def list_worker_pools() -> Dict[str, Any]:
+    """Per-raylet worker-pool / provisioning-plane stats (reference
+    surface: the dashboard's /api/workers): zygote liveness, warm-pool
+    size, adoption hit/miss and fork/cold-spawn counters — mirrored to
+    GCS KV ns="workers" by each raylet's metrics loop."""
+    return _kv_namespace_dump("workers")
 
 
 def summarize_cluster() -> Dict[str, Any]:

@@ -584,6 +584,22 @@ def test_asy003_positive_lambda_callback():
     assert rules_of(findings) == ["ASY003"]
 
 
+def test_asy003_positive_append_to_longlived_state():
+    """`self._background.append(ensure_future(...))` keeps a handle but
+    nobody ever awaits a shutdown-only list: failures stay silent. The
+    tightened rule catches the shape (and `.add` on sets)."""
+    findings = lint("""
+        import asyncio
+
+        class S:
+            async def start(self):
+                self._background.append(asyncio.ensure_future(self._loop()))
+                self._tasks.add(asyncio.create_task(self._flush()))
+    """, rules=["ASY003"])
+    assert rules_of(findings) == ["ASY003"] * 2
+    assert "long-lived state" in findings[0].message
+
+
 def test_asy003_negative_owned_tasks():
     findings = lint("""
         import asyncio
@@ -592,10 +608,15 @@ def test_asy003_negative_owned_tasks():
         class S:
             async def run(self):
                 t = asyncio.ensure_future(self._work())       # stored
-                self._background.append(asyncio.ensure_future(self._loop()))
+                # spawn() already logs failures; appending ITS handle is fine
+                self._background.append(spawn(self._loop(), what="loop"))
                 await asyncio.ensure_future(self._work())     # awaited
                 asyncio.ensure_future(self._work()).add_done_callback(self._cb)
                 spawn(self._work(), what="sanctioned helper")
+                # a LOCAL list is awaited in-scope: allowed
+                waiters = []
+                waiters.append(asyncio.ensure_future(self._work()))
+                await asyncio.wait(waiters)
                 return t
     """, rules=["ASY003"])
     assert rules_of(findings) == []
@@ -608,6 +629,100 @@ def test_asy003_suppression():
         def kick(self):
             asyncio.ensure_future(self._work())  # raylint: disable=ASY003 guarded internally
     """, rules=["ASY003"])
+    assert rules_of(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# LCK001 — lock-order inversions across the control-plane hierarchy
+# ---------------------------------------------------------------------------
+
+
+def test_lck001_positive_inverted_nesting():
+    """Taking a GCS-tier lock while holding a core-worker-tier lock runs
+    AGAINST the GCS -> raylet -> core-worker order."""
+    findings = lint("""
+        class S:
+            def bad(self):
+                with self._core_worker_lock:
+                    with self._gcs_lock:
+                        self.sync()
+    """, rules=["LCK001"])
+    assert rules_of(findings) == ["LCK001"]
+    assert "GCS -> raylet -> core worker" in findings[0].message
+
+
+def test_lck001_positive_single_with_multiple_items():
+    """`with a, b:` acquires left-to-right — the one-line form of the same
+    inversion must be flagged too."""
+    findings = lint("""
+        class S:
+            def bad(self):
+                with self._core_worker_lock, self._gcs_lock:
+                    self.sync()
+    """, rules=["LCK001"])
+    assert rules_of(findings) == ["LCK001"]
+
+
+def test_lck001_positive_raylet_under_worker_async():
+    findings = lint("""
+        class S:
+            async def bad(self):
+                async with self._worker_lock:
+                    async with self.raylet_mutex:
+                        await self.push()
+    """, rules=["LCK001"])
+    assert rules_of(findings) == ["LCK001"]
+
+
+def test_lck001_negative_ordered_and_untier():
+    findings = lint("""
+        class S:
+            def ok(self):
+                # down the hierarchy: allowed
+                with self._gcs_lock:
+                    with self._raylet_lock:
+                        with self._core_worker_lock:
+                            self.sync()
+
+            def ok2(self):
+                # untiered locks are out of scope
+                with self._exec_lock:
+                    with self._state_lock:
+                        self.run()
+
+            def ok3(self):
+                # sequential (not nested) acquisitions are fine
+                with self._core_worker_lock:
+                    self.a()
+                with self._gcs_lock:
+                    self.b()
+    """, rules=["LCK001"])
+    assert rules_of(findings) == []
+
+
+def test_lck001_nested_def_resets_the_held_stack():
+    """A nested function runs on its own call path: holding a worker lock
+    while DEFINING a closure that takes a GCS lock is not an inversion."""
+    findings = lint("""
+        class S:
+            def ok(self):
+                with self._worker_lock:
+                    def flush():
+                        with self._gcs_lock:
+                            self.sync()
+                    return flush
+    """, rules=["LCK001"])
+    assert rules_of(findings) == []
+
+
+def test_lck001_suppression():
+    findings = lint("""
+        class S:
+            def audited(self):
+                with self._core_worker_lock:
+                    with self._gcs_lock:  # raylint: disable=LCK001 shutdown-only path, single-threaded
+                        self.sync()
+    """, rules=["LCK001"])
     assert rules_of(findings) == []
 
 

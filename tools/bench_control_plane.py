@@ -1,0 +1,223 @@
+"""Control-plane bench: actor creates/s, tasks/s, lease-grant latency.
+
+The companion to tools/stress.py for the provisioning plane (ISSUE 8 /
+ROADMAP "control-plane throughput"): measures the paths the zygote prefork
+pool + batched lease grants attack, and can run the same envelope with the
+pool DISABLED (cold subprocess spawns, the STRESS_r05 configuration) to
+show the ratio on one host.
+
+Usage:
+  python tools/bench_control_plane.py [--nodes 2] [--actors 40]
+      [--tasks 4000] [--lease-samples 50] [--out FILE]
+  python tools/bench_control_plane.py --compare --out STRESS_r06.json
+      # runs warm then cold in fresh interpreters, emits both + speedups
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RAY_TPU_JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+COLD_ENV = {
+    # the STRESS_r05 configuration: every lease miss pays a cold
+    # interpreter+import spawn, no zygote, no warm pool, no prestart
+    "RAY_TPU_WORKER_ZYGOTE_ENABLED": "0",
+    "RAY_TPU_WORKER_POOL_WARM_TARGET": "0",
+    "RAY_TPU_PRESTART_WORKERS": "0",
+}
+
+
+def phase_actors(total: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.1)
+    class _A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    actors = [_A.remote() for _ in range(total)]
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=3600.0)
+    created = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=600.0)
+    call_round = time.perf_counter() - t1
+    for a in actors:
+        ray_tpu.kill(a)
+    return {"actors": total,
+            "actor_create_wall_s": round(created, 2),
+            "actor_creates_per_s": round(total / created, 2),
+            "actor_call_round_s": round(call_round, 3)}
+
+
+def phase_tasks(total: int, window: int = 1000) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def _noop(i):
+        return i
+
+    t0 = time.perf_counter()
+    in_flight = [_noop.remote(i) for i in range(min(window, total))]
+    submitted = len(in_flight)
+    completed = 0
+    while in_flight:
+        ready, in_flight = ray_tpu.wait(
+            in_flight, num_returns=min(len(in_flight), 100), timeout=300.0)
+        completed += len(ready)
+        while submitted < total and len(in_flight) < window:
+            in_flight.append(_noop.remote(submitted))
+            submitted += 1
+    dt = time.perf_counter() - t0
+    assert completed == total, (completed, total)
+    return {"tasks": total, "tasks_wall_s": round(dt, 2),
+            "tasks_per_s": round(total / dt, 1)}
+
+
+def phase_lease_latency(samples: int) -> dict:
+    """Direct RequestWorkerLease/Return round trips against the local
+    raylet: grant latency with a warm pool is adoption cost; cold it is a
+    full worker spawn. Also measures the multi-grant form (count=8)."""
+    from ray_tpu._private import wire
+    from ray_tpu._private.rpc import RetryingRpcClient
+    from ray_tpu._private.worker import _global_worker as core
+
+    client = RetryingRpcClient(core.raylet_address)
+
+    async def one(count=1):
+        t0 = time.perf_counter()
+        reply = wire.loads(await client.call("RequestWorkerLease", wire.dumps(
+            {"resources": {"CPU": 0.1}, "job_id": None, "count": count}),
+            timeout=120.0))
+        dt = time.perf_counter() - t0
+        assert reply["status"] == "granted", reply
+        grants = [reply] + (reply.get("extra_grants") or [])
+        for g in grants:
+            await client.call("ReturnWorkerLease", wire.dumps(
+                {"lease_id": g["lease_id"]}))
+        return dt, len(grants)
+
+    lat = []
+    for _ in range(samples):
+        dt, _n = core._run(one(), 180.0)
+        lat.append(dt)
+    lat.sort()
+    _, batch = core._run(one(count=8), 180.0)
+    core._run(client.close(), 30.0)
+    return {
+        "lease_samples": samples,
+        "lease_grant_p50_ms": round(lat[len(lat) // 2] * 1000, 2),
+        "lease_grant_p95_ms": round(lat[int(len(lat) * 0.95)] * 1000, 2),
+        "lease_multigrant_count8": batch,
+    }
+
+
+def pool_stats() -> dict:
+    from ray_tpu.util.state import get_node_stats, list_nodes
+
+    out = {}
+    for n in list_nodes():
+        if not n["alive"]:
+            continue
+        stats = get_node_stats(n["address"])
+        out[n["node_id"][:10]] = stats.get("worker_pool", {})
+    return out
+
+
+def run(nodes: int, actors: int, tasks: int, lease_samples: int) -> dict:
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    wall0 = time.perf_counter()
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"resources": {"CPU": 8.0}})
+    for _ in range(nodes - 1):
+        cluster.add_node(resources={"CPU": 8.0})
+    ray_tpu.init(address=cluster.address)
+    try:
+        from ray_tpu.util.state import list_nodes
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if len([n for n in list_nodes() if n["alive"]]) >= nodes:
+                break
+            time.sleep(0.2)
+        result = {"nodes": nodes,
+                  "mode": "cold" if os.environ.get(
+                      "RAY_TPU_WORKER_ZYGOTE_ENABLED") == "0" else "warm"}
+        result.update(phase_lease_latency(lease_samples))
+        print(f"[bench] lease p50 {result['lease_grant_p50_ms']}ms",
+              flush=True)
+        result.update(phase_actors(actors))
+        print(f"[bench] actors: {result['actor_creates_per_s']}/s", flush=True)
+        result.update(phase_tasks(tasks))
+        print(f"[bench] tasks: {result['tasks_per_s']}/s", flush=True)
+        result["worker_pools"] = pool_stats()
+        result["total_wall_s"] = round(time.perf_counter() - wall0, 2)
+        return result
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def compare(args) -> dict:
+    """Run warm and cold in fresh interpreters (env must be set before the
+    cluster boots; children inherit)."""
+    out = {}
+    for mode in ("warm", "cold"):
+        env = dict(os.environ)
+        if mode == "cold":
+            env.update(COLD_ENV)
+        tmp = f"/tmp/_bench_cp_{mode}.json"
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--nodes", str(args.nodes), "--actors", str(args.actors),
+               "--tasks", str(args.tasks),
+               "--lease-samples", str(args.lease_samples), "--out", tmp]
+        print(f"[bench] === {mode} run ===", flush=True)
+        subprocess.run(cmd, env=env, check=True, timeout=3600)
+        with open(tmp) as f:
+            out[mode] = json.load(f)
+    out["speedup_actor_creates"] = round(
+        out["warm"]["actor_creates_per_s"]
+        / max(out["cold"]["actor_creates_per_s"], 1e-9), 1)
+    out["speedup_tasks"] = round(
+        out["warm"]["tasks_per_s"] / max(out["cold"]["tasks_per_s"], 1e-9), 2)
+    out["speedup_lease_p50"] = round(
+        out["cold"]["lease_grant_p50_ms"]
+        / max(out["warm"]["lease_grant_p50_ms"], 1e-9), 1)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=2)
+    ap.add_argument("--actors", type=int, default=40)
+    ap.add_argument("--tasks", type=int, default=4000)
+    ap.add_argument("--lease-samples", type=int, default=50)
+    ap.add_argument("--compare", action="store_true",
+                    help="run warm AND cold (fresh interpreters), emit both")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    if args.compare:
+        result = compare(args)
+    else:
+        result = run(args.nodes, args.actors, args.tasks, args.lease_samples)
+    result["argv"] = sys.argv[1:]
+    print(json.dumps(result, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

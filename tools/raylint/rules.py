@@ -20,7 +20,12 @@ suppression guidance per rule.
 * ASY003 — a leaked asyncio task: ``asyncio.ensure_future``/``create_task``
   whose result is neither awaited, stored, nor given a done-callback — its
   exception is swallowed until GC (often never); use
-  ``ray_tpu._private.async_util.spawn``.
+  ``ray_tpu._private.async_util.spawn``. Also flags the
+  ``self._background.append(ensure_future(...))`` shape: a handle parked in
+  long-lived state until shutdown swallows failures just the same.
+* LCK001 — lock-order inversion across the GCS -> raylet -> core-worker
+  hierarchy: nesting tiered locks against the call direction is the ABBA
+  deadlock that wedges a whole node's control plane.
 """
 
 from __future__ import annotations
@@ -274,8 +279,8 @@ class LeakedAsyncioTask(Rule):
         findings = []
         for node in ast.walk(module.tree):
             # only a bare expression STATEMENT discards the task; an
-            # assignment, append(...) argument, await, or chained
-            # .add_done_callback(...) all keep an owner
+            # assignment, await, or chained .add_done_callback(...) keep an
+            # owner (appending to long-lived state is handled below)
             if not isinstance(node, ast.Expr):
                 continue
             value = node.value
@@ -287,8 +292,8 @@ class LeakedAsyncioTask(Rule):
                     "done-callback — its exception dies with the task "
                     "object; use ray_tpu._private.async_util.spawn(...) "
                     "(or keep a handle / add_done_callback)"))
-            # lambda bodies passed to call_later/call_soon share the leak
             elif isinstance(value, ast.Call):
+                # lambda bodies passed to call_later/call_soon share the leak
                 for arg in value.args:
                     if isinstance(arg, ast.Lambda) \
                             and isinstance(arg.body, ast.Call) \
@@ -298,6 +303,24 @@ class LeakedAsyncioTask(Rule):
                             "fire-and-forget task spawned inside a lambda "
                             "callback; route through async_util.spawn so "
                             "failures are logged"))
+                # `self._background.append(ensure_future(...))`: the handle
+                # is kept (so the bare-Expr branch misses it) but nothing
+                # ever awaits a list parked until shutdown — the crash is
+                # still silent until GC. A LOCAL list (`waiters.append`) is
+                # typically awaited in-scope and stays allowed.
+                if (isinstance(value.func, ast.Attribute)
+                        and value.func.attr in ("append", "add")
+                        and isinstance(value.func.value, ast.Attribute)
+                        and len(value.args) == 1
+                        and isinstance(value.args[0], ast.Call)
+                        and _is_spawn_call(value.args[0], module.resolver)):
+                    findings.append(self.finding(
+                        module, value.args[0],
+                        "task appended to long-lived state without failure "
+                        "logging: a stored-but-never-awaited task swallows "
+                        "its exception until GC; append "
+                        "async_util.spawn(...) instead (same handle, "
+                        "logged failures)"))
         return iter(findings)
 
 
@@ -514,6 +537,114 @@ class TracerEscape(Rule):
             if _is_traced_def(node, resolver) or node.name in traced_names:
                 scan_traced_body(node)
         return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# LCK001 — lock-order inversions across the control-plane hierarchy
+# ---------------------------------------------------------------------------
+
+# The control plane's lock hierarchy follows its call direction:
+# GCS (tier 0) -> raylet (tier 1) -> core worker (tier 2). A thread/task may
+# nest lock acquisitions only DOWN the hierarchy (gcs lock, then raylet
+# lock, then worker lock). Two call paths nesting in opposite orders is the
+# classic ABBA deadlock — and across these components it wedges scheduling
+# for the whole node, not one request. Locks are tiered by name
+# (`_gcs_lock`, `raylet_mutex`, `_core_worker_lock`, ...); locks whose
+# names carry no tier are out of scope, as is any pair within one tier.
+_LCK_TIERS = (
+    ("gcs", 0),
+    ("raylet", 1),
+    ("core_worker", 2), ("core", 2), ("worker", 2),
+)
+
+
+def _lock_tier(dotted: Optional[str]) -> Optional[int]:
+    name = _terminal(dotted).lower()
+    for marker, tier in _LCK_TIERS:
+        if marker in name:
+            return tier
+    return None
+
+
+@register_rule
+class LockOrderInversion(Rule):
+    name = "LCK001"
+    summary = ("lock acquired AGAINST the GCS -> raylet -> core-worker "
+               "hierarchy while a lower-tier lock is held (ABBA deadlock "
+               "across control-plane components)")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        rule = self
+        resolver = module.resolver
+
+        def lock_exprs(items):
+            """(tier, dotted) for each tiered lock taken by a with-item."""
+            out = []
+            for item in items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):  # `with lock.acquire_timeout()`
+                    expr = expr.func
+                    if isinstance(expr, ast.Attribute):
+                        expr = expr.value
+                if isinstance(expr, (ast.Name, ast.Attribute)) \
+                        and _is_lock_like(expr, resolver):
+                    tier = _lock_tier(resolver.dotted(expr))
+                    if tier is not None:
+                        out.append((tier, resolver.dotted(expr)))
+            return out
+
+        class V(ast.NodeVisitor):
+            """Tracks the stack of held tiered locks through with-nesting.
+            The stack resets at function boundaries (a nested def runs on
+            its own call path)."""
+
+            def __init__(self):
+                self.held: List[tuple] = []
+                self.findings: List[Finding] = []
+
+            def _visit_with(self, node):
+                taken = lock_exprs(node.items)
+                # push incrementally: `with a, b:` acquires left-to-right,
+                # so b must be checked against a, not only against outer
+                # with-statements
+                for tier, dotted in taken:
+                    for held_tier, held_dotted in self.held:
+                        if tier < held_tier:
+                            self.findings.append(rule.finding(
+                                module, node,
+                                f"`{dotted}` (tier {tier}) acquired while "
+                                f"holding `{held_dotted}` (tier "
+                                f"{held_tier}): lock order must follow "
+                                f"GCS -> raylet -> core worker; invert the "
+                                f"nesting or release the inner lock first"))
+                    self.held.append((tier, dotted))
+                self.generic_visit(node)
+                if taken:
+                    del self.held[-len(taken):]
+
+            def visit_With(self, node):
+                self._visit_with(node)
+
+            def visit_AsyncWith(self, node):
+                self._visit_with(node)
+
+            def _visit_fn(self, node):
+                saved, self.held = self.held, []
+                self.generic_visit(node)
+                self.held = saved
+
+            def visit_FunctionDef(self, node):
+                self._visit_fn(node)
+
+            def visit_AsyncFunctionDef(self, node):
+                self._visit_fn(node)
+
+            def visit_Lambda(self, node):
+                self._visit_fn(node)
+
+        v = V()
+        v.visit(module.tree)
+        return iter(v.findings)
 
 
 # ---------------------------------------------------------------------------
