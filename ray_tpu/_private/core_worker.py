@@ -2565,12 +2565,6 @@ class CoreWorker:
                 self.ref_counter.add_borrower(oid, req["address"])
                 self._watch_borrower(oid, req["address"])
             return wire.dumps({"status": "ok"})
-        if method == "RemoveBorrower":
-            # legacy/no-op-compatible explicit release (owner watches are
-            # the primary removal path)
-            req = wire.loads(payload)
-            self.ref_counter.remove_borrower(req["oid"], req["address"])
-            return wire.dumps({"status": "ok"})
         if method == "WaitBorrowsDone":
             # borrower side of the owner's watch: long-poll until any of
             # the probed oids is fully released here
@@ -2719,9 +2713,6 @@ class CoreWorker:
                        and self.actor_id is not None
                        and self.actor_id.binary() == req["actor_id"])
             return wire.dumps({"hosting": hosting})
-        if method == "Exit":
-            self.loop.call_later(0.1, os._exit, 0)
-            return wire.dumps({"status": "ok"})
         raise RpcError(f"core worker: unknown method {method}")
 
     async def _handle_get_owned(self, req) -> bytes:

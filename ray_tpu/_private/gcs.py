@@ -782,9 +782,6 @@ class GcsServer:
             return {"status": "ok"}
         return {"status": "unknown_job"}
 
-    async def _rpc_ListJobs(self, req, conn):
-        return {"jobs": list(self.jobs.values())}
-
     async def _finish_job(self, job_id: JobID):
         job = self.jobs.get(job_id)
         if job is None or job["state"] == "FINISHED":

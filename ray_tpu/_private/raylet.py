@@ -955,6 +955,7 @@ class Raylet:
         spawn(self._announce([req["oid"]], attempt), what="object announce")
         return {"status": "ok"}
 
+    # raylint: disable=WIRE002 store wire protocol kept for out-of-tree callers: the object-plane race tests (tests/test_object_plane_race.py) drive seal/attempt fencing through this method directly
     async def _rpc_StorePutInline(self, req, conn):
         attempt = req.get("attempt", 0)
         if not self.store.put_inline(req["oid"], req["blob"], attempt,
@@ -1043,6 +1044,7 @@ class Raylet:
             return {"status": "timeout"}
         return self.store.access(oid)
 
+    # raylint: disable=WIRE002 store wire protocol kept for out-of-tree callers: the object-plane race tests probe spill/eviction state through this method directly
     async def _rpc_StoreContains(self, req, conn):
         return {"contains": self.store.contains(req["oid"])}
 
@@ -1241,17 +1243,28 @@ def main():
     args = parser.parse_args()
     setup_process_logging("raylet", args.log_dir)
 
+    from ray_tpu._private.object_store import sweep_stale_shm
+
+    # sweep BEFORE the store arena is created, then construct the raylet in
+    # sync context, before the event loop exists: ObjectStoreServer may
+    # compile the native store (a g++ subprocess with a 120 s budget) and the
+    # loop must never be parked behind it (ASY004). asyncio primitives
+    # created in __init__ are loop-lazy on py>=3.10.
+    swept = sweep_stale_shm()
+    if swept:
+        logger.info("swept %d stale shm segments", swept)
+    raylet = Raylet(
+        gcs_address=args.gcs_address,
+        node_id=NodeID.from_hex(args.node_id) if args.node_id else None,
+        resources=json.loads(args.resources),
+        labels=json.loads(args.labels),
+        is_head=args.head,
+        port=args.port,
+        log_dir=args.log_dir,
+        object_store_memory=args.object_store_memory or None,
+    )
+
     async def run():
-        raylet = Raylet(
-            gcs_address=args.gcs_address,
-            node_id=NodeID.from_hex(args.node_id) if args.node_id else None,
-            resources=json.loads(args.resources),
-            labels=json.loads(args.labels),
-            is_head=args.head,
-            port=args.port,
-            log_dir=args.log_dir,
-            object_store_memory=args.object_store_memory or None,
-        )
         addr = await raylet.start()
         if args.address_file:
             tmp = args.address_file + ".tmp"
@@ -1268,11 +1281,6 @@ def main():
         await stop_ev.wait()
         await raylet.stop()
 
-    from ray_tpu._private.object_store import sweep_stale_shm
-
-    swept = sweep_stale_shm()
-    if swept:
-        logger.info("swept %d stale shm segments", swept)
     asyncio.run(run())
 
 

@@ -239,7 +239,7 @@ class CheckpointStore:
 
             _internal_kv_put(self.name.encode(), wire.dumps(self.stats()),
                              namespace="ckpt")
-        except Exception:  # raylint: disable=EXC001 stats mirror is best-effort by contract
+        except Exception:  # stats mirror is best-effort by contract
             pass
 
     # -- counters ------------------------------------------------------

@@ -234,7 +234,7 @@ class WeightStoreActor:
 
             _internal_kv_put(self.name.encode(), wire.dumps(self.stats()),
                              namespace="weights")
-        except Exception:  # raylint: disable=EXC001 stats mirror is best-effort by contract
+        except Exception:  # stats mirror is best-effort by contract
             pass
 
 

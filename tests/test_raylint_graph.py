@@ -1,0 +1,603 @@
+"""Unit tests for raylint's whole-program layers: the call graph
+(tools/raylint/graph.py) and the CFG/dataflow engine (tools/raylint/flow.py)
+that the interprocedural rules (ASY004/LCK002/AWT002/WIRE002) run on."""
+
+import ast
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.raylint import flow  # noqa: E402
+from tools.raylint.graph import (  # noqa: E402
+    GraphView,
+    ProjectGraph,
+    summarize_module,
+    _modname,
+)
+
+
+def summarize(src, path="ray_tpu/_private/m.py"):
+    return summarize_module(path, textwrap.dedent(src))
+
+
+def make_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+# ---------------------------------------------------------------------------
+# summaries: functions, async coloring, calls, locks
+# ---------------------------------------------------------------------------
+
+
+def test_summary_async_coloring_and_qualnames():
+    s = summarize("""
+        def top():
+            pass
+
+        async def atop():
+            def inner():
+                pass
+
+        class C:
+            def m(self):
+                pass
+
+            async def am(self):
+                pass
+    """)
+    fns = s["functions"]
+    assert fns["top"]["is_async"] is False
+    assert fns["atop"]["is_async"] is True
+    assert fns["atop.inner"]["is_async"] is False  # nested def, own entry
+    assert fns["C.m"]["is_async"] is False
+    assert fns["C.am"]["is_async"] is True
+    assert fns["C.m"]["cls"] == "C"
+
+
+def test_summary_records_calls_with_alias_expansion():
+    s = summarize("""
+        from time import sleep as zzz
+        import subprocess as sp
+
+        def f(self):
+            zzz(1)
+            sp.run(["x"])
+            self._helper()
+    """)
+    raws = {c["raw"] for c in s["functions"]["f"]["calls"]}
+    assert "time.sleep" in raws
+    assert "subprocess.run" in raws
+    assert "self._helper" in raws
+    # direct blocking calls are pre-extracted for the chain query
+    whats = {b["what"] for b in s["functions"]["f"]["blocking"]}
+    assert whats == {"time.sleep", "subprocess.run"}
+
+
+def test_summary_lock_edges_and_held_calls():
+    s = summarize("""
+        import threading
+
+        class C:
+            def f(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+                    self.helper()
+    """)
+    f = s["functions"]["C.f"]
+    mod = _modname("ray_tpu/_private/m.py")
+    a = f"{mod}:C._a_lock"
+    b = f"{mod}:C._b_lock"
+    assert [a, b] == [l for l, _ in f["acquires"]]
+    assert [[a, b, 7]] == [e for e in f["lock_edges"]]
+    held_calls = [(c["raw"], c["held"]) for c in f["calls"] if c["held"]]
+    assert ("self.helper", [a]) in held_calls
+
+
+def test_summary_module_level_lock_identity():
+    s = summarize("""
+        import threading
+
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                pass
+    """)
+    mod = _modname("ray_tpu/_private/m.py")
+    assert s["functions"]["f"]["acquires"] == [[f"{mod}:_lock", 7]]
+
+
+# ---------------------------------------------------------------------------
+# resolution: method vs module calls, cross-module, constructors
+# ---------------------------------------------------------------------------
+
+
+def test_resolution_self_method_vs_module_function(tmp_path):
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": """
+        def helper():
+            pass
+
+        class C:
+            def helper(self):
+                pass
+
+            def go(self):
+                self.helper()
+                helper()
+    """})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    path = "ray_tpu/_private/m.py"
+    go = g.summaries[path]["functions"]["C.go"]
+    targets = {view.resolve_call(path, go, c) for c in go["calls"]}
+    assert (path, "C.helper") in targets   # self.helper() -> the method
+    assert (path, "helper") in targets     # helper() -> module function
+
+
+def test_resolution_cross_module_and_constructor(tmp_path):
+    root = make_tree(tmp_path, {
+        "ray_tpu/_private/a.py": """
+            from ray_tpu._private.b import worker, Klass
+            import ray_tpu._private.b as bmod
+
+            def go():
+                worker()
+                bmod.worker()
+                Klass()
+        """,
+        "ray_tpu/_private/b.py": """
+            def worker():
+                pass
+
+            class Klass:
+                def __init__(self):
+                    pass
+        """,
+    })
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    go = g.summaries["ray_tpu/_private/a.py"]["functions"]["go"]
+    targets = [view.resolve_call("ray_tpu/_private/a.py", go, c)
+               for c in go["calls"]]
+    assert targets.count(("ray_tpu/_private/b.py", "worker")) == 2
+    assert ("ray_tpu/_private/b.py", "Klass.__init__") in targets
+
+
+def test_resolution_base_class_method_same_module(tmp_path):
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": """
+        class Base:
+            def shared(self):
+                pass
+
+        class Child(Base):
+            def go(self):
+                self.shared()
+    """})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    path = "ray_tpu/_private/m.py"
+    go = g.summaries[path]["functions"]["Child.go"]
+    assert view.resolve_call(path, go, go["calls"][0]) == (path, "Base.shared")
+
+
+def test_blocking_chain_crosses_modules_and_memoizes(tmp_path):
+    root = make_tree(tmp_path, {
+        "ray_tpu/_private/a.py": """
+            from ray_tpu._private.b import step
+
+            def outer():
+                step()
+        """,
+        "ray_tpu/_private/b.py": """
+            import time
+
+            def step():
+                time.sleep(1)
+        """,
+    })
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    hit = view.blocking_chain(("ray_tpu/_private/a.py", "outer"))
+    assert hit is not None
+    chain, what, _hint = hit
+    assert what == "time.sleep"
+    assert [q for _, q, _ in chain] == ["outer", "step"]
+    # an async function never participates in a sync chain
+    assert view.blocking_chain(("ray_tpu/_private/a.py", "missing")) is None
+
+
+# ---------------------------------------------------------------------------
+# cache: warm hits, invalidation on edit, schema versioning
+# ---------------------------------------------------------------------------
+
+
+def test_cache_invalidation_on_file_edit(tmp_path):
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": """
+        def f():
+            pass
+    """})
+    cache = tmp_path / "graphcache.json"
+    g1 = ProjectGraph(root, cache_path=cache)
+    assert g1.stats["parsed"] == 1 and g1.stats["cache_hits"] == 0
+    assert cache.is_file()
+
+    # warm rebuild: pure cache hits, no re-parse
+    g2 = ProjectGraph(root, cache_path=cache)
+    assert g2.stats["cache_hits"] == 1 and g2.stats["parsed"] == 0
+    assert g2.summaries == g1.summaries
+
+    # edit the file: its hash changes, so only it re-parses
+    (root / "ray_tpu/_private/m.py").write_text("def g():\n    pass\n")
+    g3 = ProjectGraph(root, cache_path=cache)
+    assert g3.stats["parsed"] == 1 and g3.stats["cache_hits"] == 0
+    assert "g" in g3.summaries["ray_tpu/_private/m.py"]["functions"]
+    assert "f" not in g3.summaries["ray_tpu/_private/m.py"]["functions"]
+
+
+def test_cache_schema_version_mismatch_forces_rebuild(tmp_path):
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": "def f():\n    pass\n"})
+    cache = tmp_path / "graphcache.json"
+    ProjectGraph(root, cache_path=cache)
+    doc = json.loads(cache.read_text())
+    doc["version"] = -1
+    cache.write_text(json.dumps(doc))
+    g = ProjectGraph(root, cache_path=cache)
+    assert g.stats["parsed"] == 1 and g.stats["cache_hits"] == 0
+
+
+def test_corrupt_cache_is_ignored(tmp_path):
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": "def f():\n    pass\n"})
+    cache = tmp_path / "graphcache.json"
+    cache.write_text("{not json")
+    g = ProjectGraph(root, cache_path=cache)
+    assert g.stats["parsed"] == 1
+    # and the bad file was replaced with a valid one
+    assert json.loads(cache.read_text())["files"]
+
+
+# ---------------------------------------------------------------------------
+# lock graph: cycle fixture at the graph level
+# ---------------------------------------------------------------------------
+
+
+def test_lock_graph_cycle_edges(tmp_path):
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": """
+        import threading
+
+        class P:
+            def one(self):
+                with self._a_lock:
+                    self.grab_b()
+
+            def grab_b(self):
+                with self._b_lock:
+                    pass
+
+            def two(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    edges = view.lock_graph(("ray_tpu/_private/",))
+    mod = _modname("ray_tpu/_private/m.py")
+    a, b = f"{mod}:P._a_lock", f"{mod}:P._b_lock"
+    assert (a, b) in edges  # via the call edge one -> grab_b
+    assert (b, a) in edges  # via lexical nesting in two
+    # rlock registry: none constructed here
+    assert view.rlock_ids() == set()
+
+
+def test_rlock_construction_is_recorded(tmp_path):
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": """
+        import threading
+
+        class P:
+            def __init__(self):
+                self._re_lock = threading.RLock()
+    """})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    mod = _modname("ray_tpu/_private/m.py")
+    assert GraphView(g).rlock_ids() == {f"{mod}:P._re_lock"}
+
+
+# ---------------------------------------------------------------------------
+# RPC universe: handlers, dispatcher arms, wrappers — WIRE002's raw material
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_universe_collection(tmp_path):
+    root = make_tree(tmp_path, {
+        "ray_tpu/_private/server.py": """
+            class S:
+                async def _rpc_Alpha(self, req, conn):
+                    return {}
+
+                async def _handle(self, method, payload, conn):
+                    if method == "Beta":
+                        return b""
+        """,
+        "ray_tpu/_private/client.py": """
+            class C:
+                async def _wrapped_call(self, method, payload):
+                    pass
+
+                async def go(self, client, kind):
+                    await client.call("Alpha", b"")
+                    method = "Beta" if kind else "Alpha"
+                    await client.call(method, b"")
+                    await self._wrapped_call("Gamma", b"")
+        """,
+    })
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    handlers = view.rpc_handlers()
+    calls = view.rpc_calls()
+    assert set(handlers) == {"Alpha", "Beta"}
+    # direct literal, via-variable literals, and wrapper `method` param
+    assert set(calls) == {"Alpha", "Beta", "Gamma"}
+
+
+def test_wire_registry_extraction():
+    s = summarize("""
+        def register_struct(cls, fields=None, decode=None):
+            return cls
+
+        class Spec:
+            pass
+
+        register_struct(Spec, fields=("a", "b"),
+                        decode=lambda f: Spec(f["a"], f["b"], f["ghost"]))
+    """, path="ray_tpu/_private/wire.py")
+    (entry,) = s["wire_registry"]
+    assert entry["fields"] == ["a", "b"]
+    assert entry["decode_fields"] == ["a", "b", "ghost"]
+
+
+# ---------------------------------------------------------------------------
+# flow layer: CFG shape, may-analysis, reaching definitions
+# ---------------------------------------------------------------------------
+
+
+def _fn(src):
+    tree = ast.parse(textwrap.dedent(src))
+    return next(n for n in ast.walk(tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+
+
+def test_cfg_if_branches_join():
+    cfg = flow.build_cfg(_fn("""
+        def f(c):
+            a = 1
+            if c:
+                b = 2
+            else:
+                b = 3
+            return b
+    """))
+    # the return node is reachable from both branch bodies
+    ret = next(i for i, n in enumerate(cfg.nodes) if isinstance(n, ast.Return))
+    preds = cfg.preds()[ret]
+    assert len(preds) == 2
+
+
+def test_cfg_while_has_back_edge():
+    cfg = flow.build_cfg(_fn("""
+        def f(n):
+            while n:
+                n -= 1
+            return n
+    """))
+    loop = next(i for i, n in enumerate(cfg.nodes) if isinstance(n, ast.While))
+    body = next(i for i, n in enumerate(cfg.nodes)
+                if isinstance(n, ast.AugAssign))
+    assert loop in cfg.succ[body]  # back edge
+
+
+def test_cfg_try_body_reaches_handler():
+    cfg = flow.build_cfg(_fn("""
+        def f():
+            risky()
+            cleanup()
+    """))
+    assert len(cfg.nodes) == 2
+    cfg = flow.build_cfg(_fn("""
+        def f():
+            try:
+                risky()
+            except Exception:
+                handle()
+            done()
+    """))
+    risky = next(i for i, n in enumerate(cfg.nodes)
+                 if "risky" in ast.dump(n))
+    handle = next(i for i, n in enumerate(cfg.nodes)
+                  if "handle" in ast.dump(n))
+    assert handle in cfg.succ[risky]  # the exception path exists
+
+
+def test_forward_may_unions_branches():
+    fn = _fn("""
+        def f(c):
+            if c:
+                acquire()
+            use()
+    """)
+    cfg = flow.build_cfg(fn)
+
+    def transfer(stmt, facts):
+        if "acquire" in ast.dump(stmt):
+            return facts | {"L"}
+        return facts
+
+    IN = flow.forward_may(cfg, transfer)
+    use = next(i for i, n in enumerate(cfg.nodes) if "use" in ast.dump(n))
+    assert IN[use] == frozenset({"L"})  # may-held via the if-branch
+
+
+def test_reaching_defs_tracks_unique_and_merged():
+    fn = _fn("""
+        def f(c):
+            x = source_a()
+            if c:
+                x = source_b()
+            sink(x)
+    """)
+    cfg = flow.build_cfg(fn)
+    defs = flow.reaching_defs(cfg)
+    sink = next(i for i, n in enumerate(cfg.nodes) if "sink" in ast.dump(n))
+    values = defs[sink]["x"]
+    assert len(values) == 2  # both definitions may reach the sink
+    dumped = " ".join(ast.dump(v) for v in values if v is not None)
+    assert "source_a" in dumped and "source_b" in dumped
+
+
+# ---------------------------------------------------------------------------
+# memoization discipline: pruned traversals must not poison the cache
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_chain_memo_not_poisoned_by_cycle_pruning(tmp_path):
+    # entry_a explores the a<->b cycle first; the pruned traversal of b
+    # must not memoize "no blocking" for b, or entry_b's real chain
+    # (b -> a -> c -> time.sleep) silently disappears (order-dependent
+    # false negative)
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": """
+        import time
+
+        def c():
+            time.sleep(1)
+
+        def a(n):
+            if n:
+                b(n - 1)
+            c()
+
+        def b(n):
+            a(n)
+
+        async def entry_a():
+            a(1)
+
+        async def entry_b():
+            b(1)
+    """})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    path = "ray_tpu/_private/m.py"
+    assert view.blocking_chain((path, "a")) is not None
+    assert view.blocking_chain((path, "b")) is not None
+    # and again, order-reversed, on a fresh view
+    view2 = GraphView(g)
+    assert view2.blocking_chain((path, "b")) is not None
+    assert view2.blocking_chain((path, "a")) is not None
+
+
+def test_transitive_acquires_memo_not_poisoned_by_cycle_pruning(tmp_path):
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": """
+        import threading
+
+        class P:
+            def a(self, n):
+                if n:
+                    self.b(n - 1)
+                with self._deep_lock:
+                    pass
+
+            def b(self, n):
+                self.a(n)
+    """})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    path = "ray_tpu/_private/m.py"
+    mod = _modname(path)
+    # probing a (which prunes at the a<->b cycle) first must not hide
+    # b's reachable acquisition afterwards
+    assert f"{mod}:P._deep_lock" in view.transitive_acquires((path, "P.a"))
+    assert f"{mod}:P._deep_lock" in view.transitive_acquires((path, "P.b"))
+
+
+def test_module_level_rlock_is_reentrancy_exempt(tmp_path):
+    # a module-global RLock re-acquired through a helper is reentrant,
+    # not a self-deadlock
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": """
+        import threading
+
+        _re_lock = threading.RLock()
+
+        def outer():
+            with _re_lock:
+                inner()
+
+        def inner():
+            with _re_lock:
+                pass
+    """})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    mod = _modname("ray_tpu/_private/m.py")
+    assert f"{mod}:_re_lock" in view.rlock_ids()
+    edges = view.lock_graph(("ray_tpu/_private/",))
+    key = (f"{mod}:_re_lock", f"{mod}:_re_lock")
+    # the self-edge may exist in the graph; LCK002 exempts it via rlock_ids
+    if key in edges:
+        assert f"{mod}:_re_lock" in view.rlock_ids()
+
+
+def test_summarize_survives_bare_name_lock_alias():
+    # `lk = _lock` (module-level lock aliased to a local) must summarize,
+    # not crash: lock_id runs during the alias pre-pass itself
+    s = summarize("""
+        import threading
+
+        _lock = threading.Lock()
+
+        def f():
+            lk = _lock
+            with lk:
+                pass
+    """)
+    mod = _modname("ray_tpu/_private/m.py")
+    assert s["functions"]["f"]["acquires"] == [[f"{mod}:_lock", 8]]
+
+
+def test_annotated_module_lock_and_rlock_are_recognized(tmp_path):
+    # AnnAssign forms: `_lock: threading.Lock = threading.Lock()` must get
+    # module-level identity (not per-function fragments), and an annotated
+    # RLock must be reentrancy-exempt in LCK002's registry
+    root = make_tree(tmp_path, {"ray_tpu/_private/m.py": """
+        import threading
+
+        _lock: threading.Lock = threading.Lock()
+
+        def put():
+            with _lock:
+                _evict()
+
+        def _evict():
+            with _lock:
+                pass
+
+        class S:
+            def __init__(self):
+                self._re_lock: threading.RLock = threading.RLock()
+    """})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    mod = _modname("ray_tpu/_private/m.py")
+    # one shared identity -> the self-deadlock edge exists in the graph
+    edges = view.lock_graph(("ray_tpu/_private/",))
+    assert (f"{mod}:_lock", f"{mod}:_lock") in edges
+    # annotated RLock recorded as reentrant
+    assert f"{mod}:S._re_lock" in view.rlock_ids()

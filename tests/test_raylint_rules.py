@@ -799,3 +799,595 @@ def test_ckp001_nonconstant_mode_is_conservative():
                 return f
     """, relpath="ray_tpu/ckpt/foo.py", rules=["CKP001"])
     assert rules_of(findings) == ["CKP001"]
+
+
+# ---------------------------------------------------------------------------
+# ASY004 — transitive blocking calls (graph-based; generalizes ASY001)
+# ---------------------------------------------------------------------------
+
+
+def test_asy004_positive_two_hop_chain(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import time
+
+        def _do_io():
+            time.sleep(1)
+
+        def _helper(self):
+            return _do_io()
+
+        class Server:
+            async def handler(self, req):
+                self._sync_step()
+                return req
+
+            def _sync_step(self):
+                _helper(self)
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["ASY004"])
+    assert rules_of(findings) == ["ASY004"]
+    # the chain names every hop down to the blocking call
+    assert "time.sleep" in findings[0].message
+    assert "_do_io" in findings[0].message
+    # anchored at the async function's call site, not the leaf helper
+    assert findings[0].line != 0
+
+
+def test_asy004_negative_direct_async_and_executor(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import asyncio
+        import time
+
+        def _blocking():
+            time.sleep(1)
+
+        async def ok(loop):
+            # handing the chain to an executor is the sanctioned pattern
+            await loop.run_in_executor(None, _blocking)
+            await asyncio.sleep(0)
+
+        def plain_sync():
+            _blocking()  # sync caller: not this rule's business
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["ASY004"])
+    assert findings == []
+
+
+def test_asy004_direct_blocking_is_asy001s_not_asy004s(tmp_path):
+    # a DIRECT blocking call has no helper chain: ASY001 territory, so the
+    # two rules never double-report one site
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    src = """
+        import time
+
+        async def f():
+            time.sleep(1)
+    """
+    only4 = lint(src, relpath="ray_tpu/_private/svc.py", root=tmp_path,
+                 rules=["ASY004"])
+    assert only4 == []
+    only1 = lint(src, relpath="ray_tpu/_private/svc.py", root=tmp_path,
+                 rules=["ASY001"])
+    assert rules_of(only1) == ["ASY001"]
+
+
+def test_asy004_suppression(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import time
+
+        def _warmup():
+            time.sleep(0.1)
+
+        async def boot():
+            _warmup()  # raylint: disable=ASY004 one-time startup, loop idle
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["ASY004"])
+    assert findings == []
+
+
+def test_asy004_cross_module_chain(tmp_path):
+    # the chain crosses a module boundary via an imported helper
+    pkg = tmp_path / "ray_tpu" / "_private"
+    pkg.mkdir(parents=True)
+    (pkg / "util_mod.py").write_text(textwrap.dedent("""
+        import subprocess
+
+        def run_tool():
+            subprocess.check_output(["ls"])
+    """))
+    findings = lint("""
+        from ray_tpu._private.util_mod import run_tool
+
+        async def handler():
+            run_tool()
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["ASY004"])
+    assert rules_of(findings) == ["ASY004"]
+    assert "subprocess.check_output" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# LCK002 — lock-order cycles in the global acquisition graph
+# ---------------------------------------------------------------------------
+
+
+def test_lck002_positive_abba_cycle_through_helpers(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Planes:
+            def __init__(self):
+                self._sched_lock = threading.Lock()
+                self._table_lock = threading.Lock()
+
+            def path_one(self):
+                with self._sched_lock:
+                    self._touch_table()
+
+            def _touch_table(self):
+                with self._table_lock:
+                    pass
+
+            def path_two(self):
+                with self._table_lock:
+                    with self._sched_lock:
+                        pass
+    """, relpath="ray_tpu/_private/planes.py", root=tmp_path,
+        rules=["LCK002"])
+    assert "LCK002" in rules_of(findings)
+    assert any("cycle" in f.message for f in findings)
+
+
+def test_lck002_positive_self_deadlock_via_helper(tmp_path):
+    # a non-reentrant lock re-acquired through a helper call is a
+    # self-deadlock the lexical rules cannot see
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def put(self, k, v):
+                with self._lock:
+                    self._evict()
+
+            def _evict(self):
+                with self._lock:
+                    pass
+    """, relpath="ray_tpu/_private/store_mod.py", root=tmp_path,
+        rules=["LCK002"])
+    assert rules_of(findings) == ["LCK002"]
+    assert "re-acquired" in findings[0].message
+
+
+def test_lck002_negative_consistent_order_and_rlock(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Planes:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self._re_lock = threading.RLock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._a_lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._b_lock:
+                    pass
+
+            def reentrant(self):
+                with self._re_lock:
+                    self._again()
+
+            def _again(self):
+                with self._re_lock:
+                    pass
+    """, relpath="ray_tpu/_private/planes.py", root=tmp_path,
+        rules=["LCK002"])
+    assert findings == []
+
+
+def test_lck002_out_of_scope_paths_are_ignored(tmp_path):
+    # LCK002 scopes to the control/weight/ckpt/serve planes
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def a(self):
+                with self._lock:
+                    self.b()
+
+            def b(self):
+                with self._lock:
+                    pass
+    """, relpath="ray_tpu/data/loader.py", root=tmp_path, rules=["LCK002"])
+    assert findings == []
+
+
+def test_lck002_suppression(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def put(self):
+                with self._lock:
+                    # interprocedural edges anchor at the call site that
+                    # nests the acquisition, so the excuse lives there
+                    self._evict()  # raylint: disable=LCK002 _evict drops the lock first on this path
+
+            def _evict(self):
+                with self._lock:
+                    pass
+    """, relpath="ray_tpu/_private/store_mod.py", root=tmp_path,
+        rules=["LCK002"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# AWT002 — await while holding a lock (flow-sensitive)
+# ---------------------------------------------------------------------------
+
+
+def test_awt002_positive_acquire_then_await(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import asyncio
+
+        class S:
+            async def step(self):
+                self._lock.acquire()
+                await asyncio.sleep(0)
+                self._lock.release()
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["AWT002"])
+    assert rules_of(findings) == ["AWT002"]
+    assert "_lock" in findings[0].message
+
+
+def test_awt002_positive_held_only_on_one_branch(tmp_path):
+    # flow-sensitivity: the lock is held at the await only on the
+    # if-branch; a may-analysis must still flag it
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import asyncio
+
+        class S:
+            async def step(self, fast):
+                if not fast:
+                    self._lock.acquire()
+                await asyncio.sleep(0)
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["AWT002"])
+    assert rules_of(findings) == ["AWT002"]
+
+
+def test_awt002_positive_helper_leaves_lock_held(tmp_path):
+    # one level of call inlining: the helper acquires and never releases
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import asyncio
+
+        class S:
+            def _grab(self):
+                self._lock.acquire()
+
+            async def step(self):
+                self._grab()
+                await asyncio.sleep(0)
+                self._lock.release()
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["AWT002"])
+    assert rules_of(findings) == ["AWT002"]
+
+
+def test_awt002_positive_alias_resolved_by_reaching_defs(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import asyncio
+
+        class S:
+            async def step(self):
+                lk = self._lock
+                lk.acquire()
+                await asyncio.sleep(0)
+                lk.release()
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["AWT002"])
+    assert rules_of(findings) == ["AWT002"]
+
+
+def test_awt002_negative_released_before_await(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import asyncio
+
+        class S:
+            def _grab(self):
+                self._lock.acquire()
+
+            def _drop(self):
+                self._lock.release()
+
+            async def ok_one(self):
+                self._lock.acquire()
+                self._lock.release()
+                await asyncio.sleep(0)
+
+            async def ok_two(self):
+                self._grab()
+                self._drop()
+                await asyncio.sleep(0)
+
+            async def ok_async_lock(self):
+                # an AWAITED acquire is an asyncio lock: fine by this rule
+                await self._aio_lock.acquire()
+                await asyncio.sleep(0)
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["AWT002"])
+    assert findings == []
+
+
+def test_awt002_suppression(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import asyncio
+
+        class S:
+            async def step(self):
+                self._lock.acquire()
+                await asyncio.sleep(0)  # raylint: disable=AWT002 single-threaded test shim; nothing else takes this lock
+                self._lock.release()
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["AWT002"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# WIRE002 — wire-schema drift
+# ---------------------------------------------------------------------------
+
+
+def test_wire002_missing_handler(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        async def ask(client):
+            return await client.call("NoSuchMethod", b"")
+    """, relpath="ray_tpu/_private/clientside.py", root=tmp_path,
+        rules=["WIRE002"])
+    assert rules_of(findings) == ["WIRE002"]
+    assert "NoSuchMethod" in findings[0].message
+    assert "no server" in findings[0].message
+
+
+def test_wire002_orphan_handler(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        class Gcs:
+            async def _rpc_NeverCalled(self, req, conn):
+                return {}
+    """, relpath="ray_tpu/_private/serverside.py", root=tmp_path,
+        rules=["WIRE002"])
+    assert rules_of(findings) == ["WIRE002"]
+    assert "NeverCalled" in findings[0].message
+    assert "no client call site" in findings[0].message
+
+
+def test_wire002_negative_both_sides_present(tmp_path):
+    # handler in one module, caller in another: parity is whole-program
+    pkg = tmp_path / "ray_tpu" / "_private"
+    pkg.mkdir(parents=True)
+    (pkg / "serverside.py").write_text(textwrap.dedent("""
+        class Gcs:
+            async def _rpc_Heartbeat(self, req, conn):
+                return {}
+
+            async def _handle(self, method, payload, conn):
+                if method == "FastPath":
+                    return b""
+    """))
+    findings = lint("""
+        async def beat(client):
+            await client.call("Heartbeat", b"")
+            await client.notify("FastPath", b"")
+    """, relpath="ray_tpu/_private/clientside.py", root=tmp_path,
+        rules=["WIRE002"])
+    assert findings == []
+
+
+def test_wire002_variable_method_and_wrapper_param(tmp_path):
+    # a literal bound to a variable, and a literal passed to a wrapper's
+    # `method` parameter, both count as call sites (no false orphans)
+    pkg = tmp_path / "ray_tpu" / "_private"
+    pkg.mkdir(parents=True)
+    (pkg / "serverside.py").write_text(textwrap.dedent("""
+        class W:
+            async def _rpc_ProfileA(self, req, conn):
+                return {}
+
+            async def _rpc_ProfileB(self, req, conn):
+                return {}
+
+            async def _rpc_Announce(self, req, conn):
+                return {}
+    """))
+    findings = lint("""
+        class R:
+            async def _notify_owner(self, owner, method, payload):
+                pass
+
+            async def go(self, client, kind):
+                method = "ProfileA" if kind == "a" else "ProfileB"
+                await client.call(method, b"")
+                await self._notify_owner("o", "Announce", {})
+    """, relpath="ray_tpu/_private/clientside.py", root=tmp_path,
+        rules=["WIRE002"])
+    assert findings == []
+
+
+def test_wire002_registry_field_drift(tmp_path):
+    # decode reads a field that is not encoded -> KeyError on every message
+    pkg = tmp_path / "ray_tpu" / "_private"
+    pkg.mkdir(parents=True)
+    (pkg / "common.py").write_text(textwrap.dedent("""
+        from dataclasses import dataclass
+
+        @dataclass
+        class Spec:
+            a: int = 0
+            b: int = 0
+    """))
+    findings = lint("""
+        from ray_tpu._private.common import Spec
+
+        def register_struct(cls, fields=None, decode=None):
+            return cls
+
+        register_struct(Spec, fields=("a",),
+                        decode=lambda f: Spec(f["a"], f["b"]))
+    """, relpath="ray_tpu/_private/wire.py", root=tmp_path,
+        rules=["WIRE002"])
+    assert rules_of(findings) == ["WIRE002"]
+    assert "`b`" in findings[0].message and "KeyError" in findings[0].message
+
+
+def test_wire002_registry_dropped_and_unknown_fields(tmp_path):
+    pkg = tmp_path / "ray_tpu" / "_private"
+    pkg.mkdir(parents=True)
+    (pkg / "common.py").write_text(textwrap.dedent("""
+        class Spec:
+            def __init__(self, a):
+                self.a = a
+    """))
+    findings = lint("""
+        from ray_tpu._private.common import Spec
+
+        def register_struct(cls, fields=None, decode=None):
+            return cls
+
+        register_struct(Spec, fields=("a", "ghost"),
+                        decode=lambda f: Spec(f["a"]))
+    """, relpath="ray_tpu/_private/wire.py", root=tmp_path,
+        rules=["WIRE002"])
+    msgs = " | ".join(f.message for f in findings)
+    # "ghost" is both dropped-by-decode and absent from the struct
+    assert "silently dropped" in msgs
+    assert "no field or constructor parameter `ghost`" in msgs
+
+
+def test_wire002_registry_negative_exact_parity(tmp_path):
+    pkg = tmp_path / "ray_tpu" / "_private"
+    pkg.mkdir(parents=True)
+    (pkg / "common.py").write_text(textwrap.dedent("""
+        from dataclasses import dataclass
+
+        @dataclass
+        class Spec:
+            a: int = 0
+            b: int = 0
+    """))
+    findings = lint("""
+        from ray_tpu._private.common import Spec
+
+        def register_struct(cls, fields=None, decode=None):
+            return cls
+
+        register_struct(Spec, fields=("a", "b"),
+                        decode=lambda f: Spec(f["a"], f["b"]))
+        register_struct(Spec)  # dataclass-default fields: definitionally in sync
+    """, relpath="ray_tpu/_private/wire.py", root=tmp_path,
+        rules=["WIRE002"])
+    assert findings == []
+
+
+def test_wire002_suppression(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        class Gcs:
+            # raylint: disable=WIRE002 debug surface for external tooling
+            async def _rpc_DebugDump(self, req, conn):
+                return {}
+    """, relpath="ray_tpu/_private/serverside.py", root=tmp_path,
+        rules=["WIRE002"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SUP001 — stale suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_sup001_stale_directive_is_an_error():
+    findings = lint("""
+        import time
+
+        def sync_fn():
+            time.sleep(1)  # raylint: disable=ASY001 not async at all
+    """, rules=["ASY001", "SUP001"])
+    assert rules_of(findings) == ["SUP001"]
+    assert "disable=ASY001" in findings[0].message
+
+
+def test_sup001_used_directive_is_fine():
+    findings = lint("""
+        import time
+
+        async def f():
+            time.sleep(1)  # raylint: disable=ASY001 reviewed: measured dwell is 2us
+    """, rules=["ASY001", "SUP001"])
+    assert findings == []
+
+
+def test_sup001_escape_hatch_keeps_dormant_directive():
+    findings = lint("""
+        import time
+
+        def sync_fn():
+            # raylint: disable=ASY001,SUP001 becomes async again in the MPMD refactor; keep the fence
+            time.sleep(1)
+    """, rules=["ASY001", "SUP001"])
+    assert findings == []
+
+
+def test_sup001_mixed_directive_flags_only_the_dead_token():
+    findings = lint("""
+        import time
+        import pickle
+
+        async def f(blob):
+            time.sleep(1)  # raylint: disable=ASY001,SER001 hot path
+            return blob
+    """, rules=["ASY001", "SER001", "SUP001"])
+    assert rules_of(findings) == ["SUP001"]
+    assert "disable=SER001" in findings[0].message
+
+
+def test_sup001_subset_runs_do_not_false_flag():
+    # judging an ASY001 directive requires ASY001 to have run
+    findings = lint("""
+        import time
+
+        def sync_fn():
+            time.sleep(1)  # raylint: disable=ASY001 not async
+    """, rules=["SER001", "SUP001"])
+    assert findings == []
+
+
+def test_sup001_stale_filewide_directive():
+    findings = lint("""
+        # raylint: disable-file=TRC001
+        x = 1
+    """, rules=["TRC001", "SUP001"])
+    assert rules_of(findings) == ["SUP001"]

@@ -1,6 +1,14 @@
 #!/usr/bin/env bash
 # One-shot lint entry point: run raylint over the runtime with the checked-in
 # baseline (exactly what tests/test_raylint.py enforces in tier-1).
+#
+# CI contract (asserted by tests/test_raylint.py::test_lint_sh_json_contract):
+#   tools/lint.sh --json     machine-readable report on stdout
+#   exit 0                   clean (every finding fixed/suppressed/baselined)
+#   exit 1                   new findings or stale baseline entries
+#   exit 2                   usage error
+# Other useful flags pass straight through: --changed (git-diff-scoped run),
+# --stats (per-rule timings), --no-graph-cache (cold whole-program build).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec python -m tools.raylint "$@"

@@ -1,4 +1,9 @@
-"""raylint: AST-based invariant checker for the ray_tpu distributed runtime.
+"""raylint: invariant checker for the ray_tpu distributed runtime.
+
+v1: per-file AST pattern rules (rules.py). v2 adds whole-program analysis:
+a project-wide import/call graph (graph.py, content-hash cached) and
+per-function CFG dataflow (flow.py) driving the interprocedural rules in
+rules_interp.py (ASY004/LCK002/AWT002/WIRE002).
 
 See tools/raylint/README.md for rules, rationale, and suppression syntax.
 Programmatic entry points:
@@ -19,4 +24,4 @@ from tools.raylint.core import (  # noqa: F401
     register_rule,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

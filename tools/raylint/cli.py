@@ -13,7 +13,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import time
 from collections import Counter
 from pathlib import Path
 
@@ -42,7 +45,37 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--list-rules", action="store_true",
                    help="list registered rules and exit")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only files changed vs git HEAD (plus "
+                        "untracked), intersected with the target paths")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-rule wall time and raw finding counts "
+                        "(and graph-cache stats) to stderr")
+    p.add_argument("--no-graph-cache", action="store_true",
+                   help="ignore and don't write tools/raylint/.graphcache.json")
     return p
+
+
+def _changed_files(repo_root: Path):
+    """Changed-vs-HEAD plus untracked .py files, repo-relative. Returns
+    None when git itself fails — the caller must error out rather than
+    treat a broken git as 'nothing changed' and report a false green."""
+    out = []
+    for args in (["git", "diff", "--name-only", "HEAD", "--", "*.py"],
+                 ["git", "ls-files", "--others", "--exclude-standard",
+                  "--", "*.py"]):
+        try:
+            proc = subprocess.run(args, cwd=repo_root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            print(f"raylint: --changed needs git: {e}", file=sys.stderr)
+            return None
+        if proc.returncode != 0:
+            print(f"raylint: `{' '.join(args)}` failed: "
+                  f"{proc.stderr.strip()}", file=sys.stderr)
+            return None
+        out.extend(l.strip() for l in proc.stdout.splitlines() if l.strip())
+    return sorted(set(out))
 
 
 def main(argv=None) -> int:
@@ -57,18 +90,47 @@ def main(argv=None) -> int:
     if args.rules:
         rule_names = [r.strip() for r in args.rules.split(",") if r.strip()]
 
-    if args.write_baseline and (args.paths or rule_names):
+    if args.write_baseline and (args.paths or rule_names or args.changed):
         # a partial run would overwrite the baseline with only its own
         # subset, silently erasing every other reviewed entry
         print("raylint: --write-baseline requires a full default run "
-              "(no explicit paths, no --rules)", file=sys.stderr)
+              "(no explicit paths, no --rules, no --changed)",
+              file=sys.stderr)
         return 2
 
+    if args.no_graph_cache:
+        # scoped to this invocation: an in-process caller (tests,
+        # programmatic use) must not have the cache silently disabled for
+        # every later run in the same interpreter
+        prior = os.environ.get("RAYLINT_NO_GRAPH_CACHE")
+        os.environ["RAYLINT_NO_GRAPH_CACHE"] = "1"
+        try:
+            return _run(args, rule_names)
+        finally:
+            if prior is None:
+                os.environ.pop("RAYLINT_NO_GRAPH_CACHE", None)
+            else:
+                os.environ["RAYLINT_NO_GRAPH_CACHE"] = prior
+    return _run(args, rule_names)
+
+
+def _run(args, rule_names) -> int:
     paths = [Path(p) for p in args.paths] or [REPO_ROOT / "ray_tpu"]
     for p in paths:
         if not p.exists():
             print(f"raylint: no such path: {p}", file=sys.stderr)
             return 2
+
+    if args.changed:
+        changed_rel = _changed_files(REPO_ROOT)
+        if changed_rel is None:
+            return 2  # git failure must not read as "nothing to lint"
+        targets = {f.resolve() for p in paths for f in core.iter_py_files([p])}
+        changed = [REPO_ROOT / rel for rel in changed_rel]
+        paths = [p for p in changed if p.exists() and p.resolve() in targets]
+        if not paths:
+            print("raylint: no changed files in scope", file=sys.stderr)
+            return 0
 
     baseline = Counter()
     if not (args.no_baseline or args.write_baseline):
@@ -80,12 +142,29 @@ def main(argv=None) -> int:
                       file=sys.stderr)
                 return 2
 
+    started = time.perf_counter()
     try:
         report = core.check_paths(paths, REPO_ROOT, baseline=baseline,
-                                  rule_names=rule_names)
+                                  rule_names=rule_names, stats=args.stats)
     except KeyError as e:
         print(f"raylint: {e.args[0]}", file=sys.stderr)
         return 2
+    elapsed = time.perf_counter() - started
+
+    if args.stats and report.stats is not None:
+        timings = report.stats.get("rule_seconds", {})
+        counts = report.stats.get("rule_findings", {})
+        print("raylint --stats (per-rule wall time over the whole run):",
+              file=sys.stderr)
+        for rule in sorted(timings, key=lambda r: -timings[r]):
+            print(f"  {rule:8s} {timings[rule] * 1000:9.1f} ms  "
+                  f"{counts.get(rule, 0):5d} raw finding(s)", file=sys.stderr)
+        g = report.stats.get("graph")
+        if g:
+            print(f"  graph    {g['build_seconds'] * 1000:9.1f} ms  "
+                  f"{g['files']} file(s), {g['cache_hits']} cache hit(s), "
+                  f"{g['parsed']} parsed", file=sys.stderr)
+        print(f"  total    {elapsed * 1000:9.1f} ms", file=sys.stderr)
 
     if args.write_baseline:
         parse_errors = [f for f in report.findings

@@ -26,6 +26,7 @@ import dataclasses
 import io
 import json
 import re
+import time
 import tokenize
 from collections import Counter
 from pathlib import Path
@@ -34,6 +35,14 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tupl
 # Rule id for files that fail to parse: a syntax error in the tree is itself a
 # finding (it would otherwise silently exempt the file from every rule).
 PARSE_ERROR_RULE = "E999"
+
+# Rule id for stale suppressions: a `# raylint: disable=RULE` that suppresses
+# zero findings is itself an error (rules.py registers the marker class; the
+# detection runs in check_source because it needs the pre-suppression finding
+# set). Escape hatch: add SUP001 to the directive's own rule list
+# (`# raylint: disable=ASY001,SUP001 <why it must stay>`) to keep a
+# deliberately-dormant suppression.
+STALE_SUPPRESSION_RULE = "SUP001"
 
 _SKIP_DIRS = {"__pycache__", ".git", "build", ".eggs", "node_modules"}
 
@@ -79,10 +88,18 @@ def register_rule(cls: type) -> type:
     return cls
 
 
+_RULESETS_LOADED = False
+
+
 def all_rules() -> Dict[str, type]:
-    """Registry of rule id -> class (imports the bundled rule set on first use)."""
-    if not _RULES:
+    """Registry of rule id -> class (imports the bundled rule sets on first
+    use — guarded by a flag, not registry emptiness, because importing one
+    rule module as a side effect of something else must not mask the rest)."""
+    global _RULESETS_LOADED
+    if not _RULESETS_LOADED:
         from tools.raylint import rules as _  # noqa: F401  (self-registers)
+        from tools.raylint import rules_interp as _i  # noqa: F401
+        _RULESETS_LOADED = True
     return dict(_RULES)
 
 
@@ -155,16 +172,25 @@ _DIRECTIVE_RE = re.compile(
 
 
 class Suppressions:
-    """Per-line and per-file ``# raylint: disable=...`` directives."""
+    """Per-line and per-file ``# raylint: disable=...`` directives.
+
+    Each directive remembers its *origin* (the comment's own line), so the
+    SUP001 stale-suppression pass can tell which directives never suppressed
+    anything. ``by_line`` maps covered line -> rule -> origin lines;
+    ``directives`` maps origin line -> the rule tokens as written (filewide
+    directives use origin line as written too, flagged in ``filewide``).
+    """
 
     def __init__(self, source: str):
-        self.by_line: Dict[int, Set[str]] = {}
-        self.filewide: Set[str] = set()
+        self.by_line: Dict[int, Dict[str, Set[int]]] = {}
+        self.filewide: Dict[str, Set[int]] = {}
+        self.directives: Dict[int, Set[str]] = {}
         try:
             tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
         except (tokenize.TokenError, IndentationError, SyntaxError):
             tokens = []
         code_lines: Set[int] = set()
+        origin_rules: Dict[int, Set[str]] = {}
         for tok in tokens:
             if tok.type == tokenize.COMMENT:
                 m = _DIRECTIVE_RE.search(tok.string)
@@ -172,13 +198,24 @@ class Suppressions:
                     continue
                 rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
                 rules = {"all" if r == "*" else r for r in rules}
+                origin = tok.start[0]
+                self.directives.setdefault(origin, set()).update(rules)
                 if m.group("filewide"):
-                    self.filewide |= rules
+                    for r in rules:
+                        self.filewide.setdefault(r, set()).add(origin)
                 else:
-                    self.by_line.setdefault(tok.start[0], set()).update(rules)
+                    origin_rules.setdefault(origin, set()).update(rules)
             elif tok.type not in (tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
                                   tokenize.DEDENT, tokenize.ENDMARKER):
                 code_lines.add(tok.start[0])
+
+        def bind(covered: int, origin: int):
+            per_rule = self.by_line.setdefault(covered, {})
+            for r in origin_rules.get(origin, ()):
+                per_rule.setdefault(r, set()).add(origin)
+
+        for origin in origin_rules:
+            bind(origin, origin)
         # a directive on its own line also covers the next code line DIRECTLY
         # below it (only comment lines may intervene — a blank line breaks the
         # binding, so a stale directive can't silently drift onto unrelated
@@ -199,22 +236,62 @@ class Suppressions:
                 nxt += 1
             return 0
 
-        for ln in sorted(self.by_line):
-            if ln in code_lines:
+        for origin in sorted(origin_rules):
+            if origin in code_lines:
                 continue
-            nxt = next_adjacent_code_line(ln)
+            nxt = next_adjacent_code_line(origin)
             while nxt:
-                self.by_line.setdefault(nxt, set()).update(self.by_line[ln])
+                bind(nxt, origin)
                 if lines[nxt - 1].lstrip().startswith("@"):
                     nxt = next_adjacent_code_line(nxt)  # decorator: descend
                 else:
                     break
 
     def covers(self, rule: str, line: int) -> bool:
-        if rule in self.filewide or "all" in self.filewide:
-            return True
-        rules = self.by_line.get(line, ())
-        return rule in rules or "all" in rules
+        return bool(self.covering_origins(rule, line))
+
+    def covering_origins(self, rule: str, line: int) -> Set[Tuple[int, str]]:
+        """(origin line, matching token) for every directive that suppresses
+        ``rule`` at ``line``; the token is the rule id or ``all``."""
+        out: Set[Tuple[int, str]] = set()
+        per_rule = self.by_line.get(line, {})
+        for token in (rule, "all"):
+            for origin in self.filewide.get(token, ()):
+                out.add((origin, token))
+            for origin in per_rule.get(token, ()):
+                out.add((origin, token))
+        return out
+
+
+def _stale_suppression_findings(module: "Module", project: "Project",
+                                used: Set[Tuple[int, str]]) -> Iterator[Finding]:
+    """SUP001: directives whose rule tokens suppressed zero findings this
+    run. Tokens for rules not in the active set are skipped (a subset run
+    can't judge them); ``all`` tokens are judged only on full-registry runs
+    for the same reason."""
+    sup = module.suppressions
+    active = {r.name for r in project.rules}
+    full_registry = active >= set(all_rules())
+    for origin in sorted(sup.directives):
+        tokens = sup.directives[origin]
+        if STALE_SUPPRESSION_RULE in tokens:
+            continue  # explicit allowlist: deliberately-dormant suppression
+        for token in sorted(tokens):
+            if token == "all":
+                if not full_registry:
+                    continue
+            elif token not in active or token == STALE_SUPPRESSION_RULE:
+                continue
+            if (origin, token) in used:
+                continue
+            yield Finding(
+                rule=STALE_SUPPRESSION_RULE, path=module.path, line=origin,
+                col=0,
+                message=(f"suppression `disable={token}` matches no {token} "
+                         f"finding: the directive is dead — delete it, or "
+                         f"add {STALE_SUPPRESSION_RULE} to its rule list "
+                         f"with a reason to keep it deliberately"),
+                snippet=module.line(origin).strip())
 
 
 # ---------------------------------------------------------------------------
@@ -257,7 +334,9 @@ class Project:
         else:
             self.rules = [cls() for cls in registry.values()]
         self.rules.sort(key=lambda r: r.name)
-        self.cache: Dict[str, object] = {}  # scratch space for project-aware rules
+        self.cache: Dict[object, object] = {}  # scratch for project-aware rules
+        self.timings: Dict[str, float] = {}  # rule id -> cumulative seconds
+        self.finding_counts: Dict[str, int] = {}  # rule id -> raw findings
 
     def relpath(self, path: Path) -> str:
         p = Path(path).resolve()
@@ -277,10 +356,31 @@ class Project:
         except ValueError as e:  # e.g. NUL bytes (ast.parse, py<=3.11)
             return [Finding(rule=PARSE_ERROR_RULE, path=relpath, line=1,
                             col=0, message=f"unparseable: {e}", snippet="")]
-        findings: List[Finding] = []
+        raw: List[Finding] = []
         for rule in self.rules:
-            for f in rule.check(module):
-                if not module.suppressions.covers(f.rule, f.line):
+            started = time.perf_counter()
+            rule_findings = list(rule.check(module))
+            self.timings[rule.name] = (self.timings.get(rule.name, 0.0)
+                                       + time.perf_counter() - started)
+            self.finding_counts[rule.name] = (
+                self.finding_counts.get(rule.name, 0) + len(rule_findings))
+            raw.extend(rule_findings)
+        findings: List[Finding] = []
+        used: Set[Tuple[int, str]] = set()  # (directive origin line, token)
+        sup = module.suppressions
+        for f in raw:
+            origins = sup.covering_origins(f.rule, f.line)
+            if origins:
+                used |= origins
+            else:
+                findings.append(f)
+        if any(r.name == STALE_SUPPRESSION_RULE for r in self.rules):
+            raw_stale = list(_stale_suppression_findings(module, self, used))
+            self.finding_counts[STALE_SUPPRESSION_RULE] = (
+                self.finding_counts.get(STALE_SUPPRESSION_RULE, 0)
+                + len(raw_stale))
+            for f in raw_stale:
+                if not sup.covering_origins(f.rule, f.line):
                     findings.append(f)
         findings.sort()
         return findings
@@ -351,6 +451,7 @@ class Report:
     baselined: List[Finding]         # matched a baseline entry
     unused_baseline: List[Tuple[str, str, str]]  # stale baseline keys
     files_checked: int
+    stats: Optional[dict] = None     # per-rule timings etc. (--stats)
 
     @property
     def ok(self) -> bool:
@@ -378,7 +479,8 @@ class Report:
 
 def check_paths(paths: Sequence[Path], root: Path,
                 baseline: Optional[Counter] = None,
-                rule_names: Optional[Sequence[str]] = None) -> Report:
+                rule_names: Optional[Sequence[str]] = None,
+                stats: bool = False) -> Report:
     project = Project(root, rule_names)
     raw: List[Finding] = []
     scanned: Set[str] = set()
@@ -405,5 +507,13 @@ def check_paths(paths: Sequence[Path], root: Path,
     unused = sorted(k for k, n in remaining.items()
                     if n > 0 and k[0] in active and k[1] in scanned
                     for _ in range(n))
+    stats_doc = None
+    if stats:
+        stats_doc = {"rule_seconds": dict(project.timings),
+                     "rule_findings": dict(project.finding_counts)}
+        g = project.cache.get("graph")
+        if g is not None:
+            stats_doc["graph"] = dict(g.stats)
     return Report(findings=new, baselined=matched,
-                  unused_baseline=unused, files_checked=len(scanned))
+                  unused_baseline=unused, files_checked=len(scanned),
+                  stats=stats_doc)

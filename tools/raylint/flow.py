@@ -1,0 +1,312 @@
+"""raylint flow layer: intraprocedural CFG + dataflow.
+
+Gives flow-sensitive rules (AWT002 today) a real control-flow graph per
+function instead of a lexical walk:
+
+* :func:`build_cfg` — statement-level CFG over one function body. Compound
+  statements (``if``/``while``/``for``/``try``/``with``) are descended into;
+  leaf statements are the CFG nodes. Loops get back edges, ``break``/
+  ``continue``/``return``/``raise`` divert to the right successor, and every
+  statement in a ``try`` body may also jump to each handler (exceptions can
+  occur anywhere — a may-analysis must see that path).
+* :func:`forward_may` — generic forward may-dataflow (union at joins,
+  iterate to fixpoint) parameterized by a per-statement transfer function.
+  Used for the held-locks analysis.
+* :func:`reaching_defs` — classic reaching definitions over the CFG:
+  for each statement, which assignment of each local name may reach it.
+  Rules use it to resolve lock aliases (``lk = self._lock; lk.acquire()``)
+  flow-sensitively.
+
+Nested function definitions and lambdas are opaque single statements here:
+their bodies run on a different call path and are analyzed as their own
+functions by the graph layer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+_OPAQUE = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+class CFG:
+    """Statement-level control-flow graph. ``nodes[i]`` is an ast.stmt;
+    ``succ[i]`` its successor indices. Node 0's predecessors: none (entry
+    edges start at ``entry``); ``EXIT`` (= -1) is the virtual exit."""
+
+    EXIT = -1
+
+    def __init__(self):
+        self.nodes: List[ast.stmt] = []
+        self.succ: Dict[int, List[int]] = {}
+        self.entry: List[int] = []
+
+    def add(self, stmt: ast.stmt) -> int:
+        idx = len(self.nodes)
+        self.nodes.append(stmt)
+        self.succ[idx] = []
+        return idx
+
+    def edge(self, a: int, b: int):
+        if b not in self.succ[a]:
+            self.succ[a].append(b)
+
+    def preds(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {i: [] for i in range(len(self.nodes))}
+        for a, succs in self.succ.items():
+            for b in succs:
+                if b != CFG.EXIT:
+                    out[b].append(a)
+        return out
+
+
+class _Builder:
+    def __init__(self):
+        self.cfg = CFG()
+        self._loop_stack: List[Tuple[List[int], List[int]]] = []  # (breaks, continues)
+
+    # Each _stmt/_suite method takes the list of dangling edge sources
+    # (node indices whose next sequential successor is unknown yet) and
+    # returns the new dangling list. try->handler edges are added by a
+    # post-pass in _try over the body's node range (covers nesting too).
+
+    def _connect(self, sources: List[int], target: int):
+        for s in sources:
+            self.cfg.edge(s, target)
+
+    def _suite(self, stmts: List[ast.stmt], incoming: List[int]) -> List[int]:
+        dangling = incoming
+        for stmt in stmts:
+            dangling = self._stmt(stmt, dangling)
+        return dangling
+
+    def _leaf(self, stmt: ast.stmt, incoming: List[int]) -> Tuple[int, List[int]]:
+        idx = self.cfg.add(stmt)
+        if not self.cfg.entry and not incoming:
+            self.cfg.entry = [idx]
+        self._connect(incoming, idx)
+        return idx, [idx]
+
+    def _stmt(self, stmt: ast.stmt, incoming: List[int]) -> List[int]:
+        if isinstance(stmt, ast.If):
+            idx, out = self._leaf(stmt, incoming)  # the test
+            then_out = self._suite(stmt.body, list(out))
+            else_out = self._suite(stmt.orelse, list(out)) \
+                if stmt.orelse else list(out)
+            return then_out + else_out
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            idx, out = self._leaf(stmt, incoming)  # test / iter
+            self._loop_stack.append(([], []))
+            body_out = self._suite(stmt.body, list(out))
+            breaks, continues = self._loop_stack.pop()
+            self._connect(body_out + continues, idx)  # back edge
+            else_out = self._suite(stmt.orelse, list(out)) \
+                if stmt.orelse else list(out)
+            return else_out + breaks
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            idx, out = self._leaf(stmt, incoming)  # the with items
+            return self._suite(stmt.body, list(out))
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, incoming)
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            idx, _ = self._leaf(stmt, incoming)
+            self.cfg.edge(idx, CFG.EXIT)
+            return []
+        if isinstance(stmt, ast.Break):
+            idx, _ = self._leaf(stmt, incoming)
+            if self._loop_stack:
+                self._loop_stack[-1][0].append(idx)
+            return []
+        if isinstance(stmt, ast.Continue):
+            idx, _ = self._leaf(stmt, incoming)
+            if self._loop_stack:
+                self._loop_stack[-1][1].append(idx)
+            return []
+        # leaf statement (incl. nested defs, which are opaque)
+        _, out = self._leaf(stmt, incoming)
+        return out
+
+    def _try(self, stmt: ast.Try, incoming: List[int]) -> List[int]:
+        # collect the body's nodes so every one can reach every handler head
+        start = len(self.cfg.nodes)
+        body_out = self._suite(stmt.body, incoming)
+        body_nodes = list(range(start, len(self.cfg.nodes)))
+        out = list(body_out)
+        handler_outs: List[int] = []
+        for h in stmt.handlers:
+            h_start = len(self.cfg.nodes)
+            h_out = self._suite(h.body, [])
+            # edge from every body node to this handler's first node
+            if len(self.cfg.nodes) > h_start:
+                head = h_start
+                for b in body_nodes:
+                    self.cfg.edge(b, head)
+                # an empty incoming list would make the handler unreachable
+                # from entry; that's correct — it's reachable via body edges
+            handler_outs.extend(h_out)
+        out.extend(handler_outs)
+        if stmt.orelse:
+            out = self._suite(stmt.orelse, body_out) + handler_outs
+        if stmt.finalbody:
+            out = self._suite(stmt.finalbody, out)
+        return out
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG over the body of a FunctionDef/AsyncFunctionDef."""
+    b = _Builder()
+    dangling = b._suite(list(fn.body), [])
+    for d in dangling:
+        b.cfg.edge(d, CFG.EXIT)
+    if not b.cfg.entry and b.cfg.nodes:
+        b.cfg.entry = [0]
+    return b.cfg
+
+
+# ---------------------------------------------------------------------------
+# Dataflow
+# ---------------------------------------------------------------------------
+
+Transfer = Callable[[ast.stmt, FrozenSet], FrozenSet]
+
+
+def forward_may(cfg: CFG, transfer: Transfer,
+                init: FrozenSet = frozenset()) -> Dict[int, FrozenSet]:
+    """Forward may-analysis: IN[n] = union(OUT[preds]); OUT[n] =
+    transfer(stmt, IN[n]). Returns the IN set per node index."""
+    n = len(cfg.nodes)
+    preds = cfg.preds()
+    IN: Dict[int, FrozenSet] = {i: frozenset() for i in range(n)}
+    OUT: Dict[int, FrozenSet] = {i: frozenset() for i in range(n)}
+    for e in cfg.entry:
+        IN[e] = init
+    work = list(range(n))
+    guard = 0
+    while work and guard < 20 * (n + 1):
+        guard += 1
+        i = work.pop(0)
+        new_in = init if i in cfg.entry else frozenset()
+        for p in preds[i]:
+            new_in = new_in | OUT[p]
+        new_out = transfer(cfg.nodes[i], new_in)
+        if new_in != IN[i] or new_out != OUT[i]:
+            IN[i], OUT[i] = new_in, new_out
+            for s in cfg.succ[i]:
+                if s != CFG.EXIT and s not in work:
+                    work.append(s)
+    return IN
+
+
+def header_children(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions owned by this CFG node itself. For compound
+    statements (whose suites are separate CFG nodes) that is only the
+    header — test / iter / with-items — never the body; for leaf
+    statements it is the whole statement."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.target, stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return list(stmt.items)
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def _header_walk(stmt: ast.stmt) -> Iterator[ast.AST]:
+    stack: List[ast.AST] = list(header_children(stmt))
+    while stack:
+        node = stack.pop()
+        if node is not stmt and isinstance(node, _OPAQUE):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def stmt_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Call expressions owned by this CFG node, not crossing nested defs
+    (nor the suites of compound statements — those are their own nodes)."""
+    for node in _header_walk(stmt):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def stmt_awaits(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Awaits owned by this CFG node (an AsyncFor/AsyncWith header is
+    itself an implicit await), not crossing nested defs or suites."""
+    if isinstance(stmt, (ast.AsyncFor, ast.AsyncWith)):
+        yield stmt
+        return
+    for node in _header_walk(stmt):
+        if isinstance(node, ast.Await):
+            yield node
+
+
+# ---------------------------------------------------------------------------
+# Reaching definitions
+# ---------------------------------------------------------------------------
+
+
+def reaching_defs(cfg: CFG) -> Dict[int, Dict[str, Tuple[ast.AST, ...]]]:
+    """For each node index, a map of local name -> the assignment value
+    expressions that may reach it. Only simple ``name = expr`` assignments
+    define names (aug-assign, for-targets, etc. map to ``()`` = unknown)."""
+    # encode facts as frozenset of (name, def_key); def registry on the side
+    defs_at: Dict[int, Dict[str, Tuple]] = {}
+    registry: Dict[int, Tuple[str, Optional[ast.AST]]] = {}
+    by_stmt: Dict[int, List[int]] = {}
+    kill_names: Dict[int, List[str]] = {}
+    next_id = [0]
+
+    def reg(name: str, value: Optional[ast.AST]) -> int:
+        next_id[0] += 1
+        registry[next_id[0]] = (name, value)
+        return next_id[0]
+
+    for i, stmt in enumerate(cfg.nodes):
+        gen: List[int] = []
+        kills: List[str] = []
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            gen.append(reg(name, stmt.value))
+            kills.append(name)
+        else:
+            # any other binding of a plain name makes it "unknown" — but only
+            # bindings owned by THIS node (a compound header's suites are
+            # separate CFG nodes with their own gen/kill)
+            targets = []
+            if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                targets = [stmt.target]
+            elif isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            for sub in _header_walk(stmt):
+                if isinstance(sub, (ast.NamedExpr,)):
+                    targets = targets + [sub.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        gen.append(reg(n.id, None))
+                        kills.append(n.id)
+        by_stmt[i] = gen
+        kill_names[i] = kills
+
+    def transfer(stmt: ast.stmt, in_set: FrozenSet) -> FrozenSet:
+        i = _index_of[id(stmt)]
+        out = {f for f in in_set if registry[f][0] not in kill_names[i]}
+        out.update(by_stmt[i])
+        return frozenset(out)
+
+    _index_of = {id(s): i for i, s in enumerate(cfg.nodes)}
+    IN = forward_may(cfg, transfer)
+    for i in range(len(cfg.nodes)):
+        env: Dict[str, Tuple] = {}
+        for f in IN[i]:
+            name, value = registry[f]
+            env.setdefault(name, ())
+            env[name] = env[name] + (value,)
+        defs_at[i] = env
+    return defs_at

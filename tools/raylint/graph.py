@@ -1,0 +1,879 @@
+"""raylint graph layer: whole-program import/call graph over ``ray_tpu/``.
+
+raylint v1 rules are per-file AST pattern matchers; the bugs that hurt most in
+the multi-plane control plane are cross-function and cross-file (a blocking
+call three sync helpers below an ``async def``, a lock-order cycle spanning
+gcs.py and raylet.py, a wire struct whose serializer and deserializer
+drifted). This module gives rules a *project* view:
+
+* :func:`summarize_module` — one pass over a module's AST producing a
+  JSON-serializable :class:`dict` summary: every function (module-level,
+  class methods, nested defs) with its async/sync color, resolved call
+  expressions, direct blocking calls, lock acquisitions (``with`` nesting
+  edges and ``.acquire()``/``.release()`` pairs), RPC handler/call-site
+  material, and wire-registry entries.
+* :class:`ProjectGraph` — the summaries for every file under
+  ``<root>/ray_tpu``, built lazily and cached to
+  ``tools/raylint/.graphcache.json`` keyed by file content hashes, so a
+  warm tier-1 run re-parses only edited files.
+* :class:`GraphView` — resolution + interprocedural queries (transitive
+  blocking chains, transitive lock acquisitions, the global lock graph,
+  RPC parity universe) over the project graph with an optional per-module
+  overlay, so in-memory fixtures (``Project.check_source``) analyze their
+  own fresh AST while still seeing the rest of the tree.
+
+Everything here is stdlib-only, like the rest of raylint.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.raylint.core import ImportResolver, iter_py_files
+from tools.raylint.rules import _BLOCKING_CALLS, _SOCKET_METHODS, _is_lock_like
+
+# bump whenever summarize_module's output shape or content rules change —
+# cached summaries from an older summarizer are silently wrong otherwise
+GRAPH_SCHEMA_VERSION = 7
+
+DEFAULT_CACHE_NAME = ".graphcache.json"
+
+# Callee terminal names whose first string-literal argument is an RPC method
+# name (RpcClient.call/notify plus the thin wrappers grown around them).
+_RPC_CALL_TERMINALS = {"call", "notify", "_gcs"}
+
+# receiver hints for `.result()` — a concurrent.futures result() blocks the
+# calling thread until the future resolves
+_FUTURE_HINTS = ("fut", "future", "promise")
+
+
+def _sha(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8", "replace")).hexdigest()
+
+
+def _modname(path: str) -> str:
+    name = path[:-3] if path.endswith(".py") else path
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+def _is_camel_method(value: str) -> bool:
+    return (bool(value) and value[0].isupper() and value.isidentifier()
+            and not value.isupper())
+
+
+def lock_identity(expr: ast.AST, resolver: ImportResolver, modname: str,
+                  cls: Optional[str], qual: str, module_locks: Set[str],
+                  aliases: Dict[str, str]) -> Optional[str]:
+    """Normalize a lock expression to a project-global identity:
+    ``self._lock`` in class C -> ``mod:C._lock``; a module-level name ->
+    ``mod:_lock``; a local alias resolves to its target; anything else
+    keeps its expanded dotted path scoped to the module (a plain local
+    gets function scope — distinct per function, by design)."""
+    dotted = resolver.dotted(expr)
+    if dotted is None:
+        return None
+    head = dotted.split(".", 1)[0]
+    if head == "self":
+        return f"{modname}:{cls or '<module>'}.{dotted[5:]}"
+    if dotted in aliases:
+        return aliases[dotted]
+    if "." not in dotted:
+        if dotted in module_locks:
+            return f"{modname}:{dotted}"
+        return f"{modname}:{qual}:{dotted}"
+    return f"{modname}:{dotted}"
+
+
+# ---------------------------------------------------------------------------
+# Module summarization
+# ---------------------------------------------------------------------------
+
+
+class _FunctionSummarizer(ast.NodeVisitor):
+    """Walks ONE function body (not descending into nested defs/lambdas),
+    collecting calls, blocking calls, lock operations, and awaits."""
+
+    def __init__(self, owner: "_ModuleSummarizer", qual: str,
+                 cls: Optional[str], node):
+        self.owner = owner
+        self.qual = qual
+        self.cls = cls
+        self.node = node
+        self.resolver = owner.resolver
+        self.calls: List[dict] = []
+        self.blocking: List[dict] = []
+        self.acquires: List[List] = []       # [lockid, line] from `with`
+        self.lock_edges: List[List] = []     # [held, acquired, line]
+        self.acq_calls: List[List] = []      # [lockid, line] from .acquire()
+        self.rel_calls: List[List] = []      # [lockid, line] from .release()
+        self.awaits: List[int] = []
+        self.held: List[str] = []            # lexical with-lock stack
+        # lock_id (called while computing the aliases) consults self.aliases,
+        # so it must exist — empty — before the alias pass runs
+        self.aliases: Dict[str, str] = {}
+        self.aliases = self._local_lock_aliases(node)
+        self.var_literals = self._literal_assigns(node)
+
+    def _local_lock_aliases(self, fn) -> Dict[str, str]:
+        """``lk = self._lock`` (assigned exactly once) lets ``with lk:`` and
+        ``lk.acquire()`` resolve to the real lock identity."""
+        assigns: Dict[str, List[Optional[str]]] = {}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                name = sub.targets[0].id
+                lock = None
+                if isinstance(sub.value, (ast.Name, ast.Attribute)) \
+                        and _is_lock_like(sub.value, self.resolver):
+                    lock = self.lock_id(sub.value)
+                assigns.setdefault(name, []).append(lock)
+        return {name: vals[0] for name, vals in assigns.items()
+                if len(vals) == 1 and vals[0] is not None}
+
+    def _literal_assigns(self, fn) -> Dict[str, List[str]]:
+        """``method = "X"`` / ``method = "A" if c else "B"`` — so a
+        ``client.call(method, ...)`` still counts as a wire-method mention
+        for WIRE002's parity check."""
+        out: Dict[str, List[str]] = {}
+
+        def lits(expr) -> List[str]:
+            if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+                    and _is_camel_method(expr.value):
+                return [expr.value]
+            if isinstance(expr, ast.IfExp):
+                return lits(expr.body) + lits(expr.orelse)
+            return []
+
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                found = lits(sub.value)
+                if found:
+                    out.setdefault(sub.targets[0].id, []).extend(found)
+        return out
+
+    # -- lock identities ----------------------------------------------------
+
+    def lock_id(self, expr: ast.AST) -> Optional[str]:
+        """Normalize a lock expression to a project-global identity."""
+        return lock_identity(expr, self.resolver, self.owner.modname,
+                             self.cls, self.qual, self.owner.module_locks,
+                             self.aliases)
+
+    # -- visitors -----------------------------------------------------------
+
+    def visit_FunctionDef(self, node):  # nested def: separate function
+        self.owner.add_function(node, parent_qual=self.qual, cls=self.cls)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.owner.add_function(node, parent_qual=self.qual, cls=self.cls)
+
+    def visit_Lambda(self, node):
+        pass  # calls inside a lambda run at the lambda's call time, not here
+
+    def visit_Await(self, node):
+        self.awaits.append(node.lineno)
+        self.generic_visit(node)
+
+    def _is_lockish(self, expr: ast.AST) -> bool:
+        """Lock-like by name, or a local alias of one (`lk = self._lock`)."""
+        if isinstance(expr, ast.Name) and expr.id in self.aliases:
+            return True
+        return isinstance(expr, (ast.Name, ast.Attribute)) \
+            and _is_lock_like(expr, self.resolver)
+
+    def _visit_with(self, node):
+        taken: List[str] = []
+        for item in node.items:
+            expr = item.context_expr
+            if self._is_lockish(expr):
+                lock = self.lock_id(expr)
+                if lock is not None:
+                    for held in self.held:
+                        self.lock_edges.append([held, lock, node.lineno])
+                    self.acquires.append([lock, node.lineno])
+                    self.held.append(lock)
+                    taken.append(lock)
+        self.generic_visit(node)
+        if taken:
+            del self.held[-len(taken):]
+
+    def visit_With(self, node):
+        self._visit_with(node)
+
+    def visit_AsyncWith(self, node):
+        self._visit_with(node)
+
+    def visit_Call(self, node: ast.Call):
+        raw = self.resolver.dotted(node.func)
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) else None
+        # literal string args that look like RPC method names
+        lits = [[i, a.value] for i, a in enumerate(node.args)
+                if isinstance(a, ast.Constant) and isinstance(a.value, str)
+                and _is_camel_method(a.value)]
+        # first-arg variable that may hold a method-name literal
+        var0 = node.args[0].id if (node.args
+                                   and isinstance(node.args[0], ast.Name)) \
+            else None
+        entry = {"raw": raw, "attr": attr, "line": node.lineno,
+                 "held": list(self.held)}
+        if lits:
+            entry["lit"] = lits
+        if var0:
+            entry["var0"] = var0
+        self.calls.append(entry)
+        self._check_blocking(node, raw, attr)
+        self._check_lock_call(node, attr)
+        self.generic_visit(node)
+
+    def _check_blocking(self, node, raw, attr):
+        if raw in _BLOCKING_CALLS:
+            self.blocking.append({
+                "what": raw, "line": node.lineno,
+                "hint": _BLOCKING_CALLS[raw]})
+        elif attr in _SOCKET_METHODS and isinstance(node.func, ast.Attribute):
+            recv = self.resolver.dotted(node.func.value) or ""
+            if "sock" in recv.lower():
+                self.blocking.append({
+                    "what": f"<socket>.{attr}", "line": node.lineno,
+                    "hint": "use asyncio streams"})
+        elif attr == "result" and isinstance(node.func, ast.Attribute):
+            recv = (self.resolver.dotted(node.func.value) or "").lower()
+            if any(h in recv for h in _FUTURE_HINTS):
+                self.blocking.append({
+                    "what": f"{recv}.result", "line": node.lineno,
+                    "hint": "blocks until the future resolves; await it (or "
+                            "wrap in run_in_executor)"})
+
+    def _check_lock_call(self, node, attr):
+        if attr not in ("acquire", "release") \
+                or not isinstance(node.func, ast.Attribute):
+            return
+        recv = node.func.value
+        if not self._is_lockish(recv):
+            return
+        lock = self.lock_id(recv)
+        if lock is None:
+            return
+        if attr == "acquire":
+            self.acq_calls.append([lock, node.lineno])
+        else:
+            self.rel_calls.append([lock, node.lineno])
+
+    def summary(self) -> dict:
+        node = self.node
+        params = [a.arg for a in node.args.posonlyargs + node.args.args]
+        return {
+            "qual": self.qual,
+            "cls": self.cls,
+            "is_async": isinstance(node, ast.AsyncFunctionDef),
+            "line": node.lineno,
+            "params": params,
+            "calls": self.calls,
+            "blocking": self.blocking,
+            "acquires": self.acquires,
+            "lock_edges": self.lock_edges,
+            "acq_calls": self.acq_calls,
+            "rel_calls": self.rel_calls,
+            "awaits": self.awaits,
+            "var_literals": self.var_literals,
+        }
+
+
+class _ModuleSummarizer:
+    def __init__(self, path: str, tree: ast.AST):
+        self.path = path
+        self.modname = _modname(path)
+        self.resolver = ImportResolver(tree)
+        self.functions: Dict[str, dict] = {}
+        self.classes: Dict[str, dict] = {}
+        self.module_locks: Set[str] = set()
+        self.rlocks: Set[str] = set()        # lock ids constructed as RLock
+        self.rpc_handlers: List[List] = []   # [name, line]
+        self.rpc_dispatch: List[List] = []   # [name, line] (method == "X")
+        self.wire_registry: List[dict] = []
+        self._collect_module_names(tree)
+        for node in tree.body:
+            self._top_level(node)
+        self._collect_dispatch_and_registry(tree)
+
+    def _collect_module_names(self, tree):
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                targets, value = [], node.value
+                for t in node.targets:
+                    targets.extend(t.elts if isinstance(t, (ast.Tuple, ast.List))
+                                   else [t])
+            elif isinstance(node, ast.AnnAssign):
+                targets, value = [node.target], node.value
+            else:
+                continue
+            is_rlock = (isinstance(value, ast.Call)
+                        and (self.resolver.dotted(value.func) or "")
+                        .endswith("RLock"))
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self.module_locks.add(t.id)
+                    if is_rlock:
+                        self.rlocks.add(f"{self.modname}:{t.id}")
+
+    def _top_level(self, node, cls: Optional[str] = None):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.add_function(node, parent_qual=None, cls=cls)
+        elif isinstance(node, ast.ClassDef) and cls is None:
+            bases = [self.resolver.dotted(b) for b in node.bases]
+            fields: List[str] = []
+            methods: List[str] = []
+            for sub in node.body:
+                if isinstance(sub, ast.AnnAssign) and isinstance(sub.target, ast.Name):
+                    fields.append(sub.target.id)
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            fields.append(t.id)
+                elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.append(sub.name)
+                    self._top_level(sub, cls=node.name)
+                    # instance attributes (`self.x = ...` anywhere in a
+                    # method) are fields too — WIRE002 checks encoded field
+                    # names against them
+                    for inner in ast.walk(sub):
+                        if isinstance(inner, (ast.Assign, ast.AnnAssign)):
+                            targets = inner.targets if isinstance(
+                                inner, ast.Assign) else [inner.target]
+                            for t in targets:
+                                if isinstance(t, ast.Attribute) \
+                                        and isinstance(t.value, ast.Name) \
+                                        and t.value.id == "self":
+                                    fields.append(t.attr)
+            init = self.functions.get(f"{node.name}.__init__")
+            init_params = init["params"][1:] if init else []
+            self.classes[node.name] = {
+                "bases": [b for b in bases if b],
+                "fields": fields,
+                "methods": methods,
+                "init_params": init_params,
+            }
+
+    def add_function(self, node, parent_qual: Optional[str],
+                     cls: Optional[str]):
+        qual = node.name if parent_qual is None else f"{parent_qual}.{node.name}"
+        if cls is not None and parent_qual is None:
+            qual = f"{cls}.{node.name}"
+        summarizer = _FunctionSummarizer(self, qual, cls, node)
+        for stmt in node.body:
+            summarizer.visit(stmt)
+        self.functions[qual] = summarizer.summary()
+        if node.name.startswith("_rpc_"):
+            self.rpc_handlers.append([node.name[5:], node.lineno])
+        # RLock detection: `self._x = threading.RLock()` / `_x = RLock()`,
+        # annotated form (`self._x: RLock = RLock()`) included
+        for sub in ast.walk(node):
+            if not isinstance(sub, (ast.Assign, ast.AnnAssign)) \
+                    or not isinstance(sub.value, ast.Call):
+                continue
+            dotted = self.resolver.dotted(sub.value.func) or ""
+            if not dotted.endswith("RLock"):
+                continue
+            targets = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+            for t in targets:
+                lock = summarizer.lock_id(t) if isinstance(
+                    t, (ast.Name, ast.Attribute)) else None
+                if lock:
+                    self.rlocks.add(lock)
+
+    def _collect_dispatch_and_registry(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = {a.arg for a in node.args.posonlyargs + node.args.args}
+                if "method" in params:
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Compare) and len(sub.ops) == 1 \
+                                and isinstance(sub.ops[0], ast.Eq):
+                            sides = [sub.left] + sub.comparators
+                            names = {s.id for s in sides
+                                     if isinstance(s, ast.Name)}
+                            lits = [s.value for s in sides
+                                    if isinstance(s, ast.Constant)
+                                    and isinstance(s.value, str)]
+                            if "method" in names and lits \
+                                    and _is_camel_method(lits[0]):
+                                self.rpc_dispatch.append([lits[0], sub.lineno])
+            elif isinstance(node, ast.Call):
+                f = node.func
+                term = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else "")
+                if term == "register_struct" and node.args:
+                    self.wire_registry.append(
+                        self._registry_entry(node))
+
+    def _registry_entry(self, call: ast.Call) -> dict:
+        cls_raw = self.resolver.dotted(call.args[0])
+        fields = None
+        decode_fields = None
+        for kw in call.keywords:
+            if kw.arg == "fields" and isinstance(kw.value, (ast.Tuple, ast.List)):
+                fields = [e.value for e in kw.value.elts
+                          if isinstance(e, ast.Constant)
+                          and isinstance(e.value, str)]
+            elif kw.arg == "decode":
+                if isinstance(kw.value, ast.Lambda) \
+                        and len(kw.value.args.args) == 1:
+                    pname = kw.value.args.args[0].arg
+                    decode_fields = sorted({
+                        sub.slice.value
+                        for sub in ast.walk(kw.value.body)
+                        if isinstance(sub, ast.Subscript)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == pname
+                        and isinstance(sub.slice, ast.Constant)
+                        and isinstance(sub.slice.value, str)})
+        return {"cls": cls_raw, "line": call.lineno, "fields": fields,
+                "decode_fields": decode_fields}
+
+    def summary(self) -> dict:
+        return {
+            "path": self.path,
+            "modname": self.modname,
+            "functions": self.functions,
+            "classes": self.classes,
+            "rlocks": sorted(self.rlocks),
+            "rpc_handlers": self.rpc_handlers,
+            "rpc_dispatch": self.rpc_dispatch,
+            "wire_registry": self.wire_registry,
+        }
+
+
+def summarize_module(path: str, source: str,
+                     tree: Optional[ast.AST] = None) -> dict:
+    """Summarize one module for the project graph. Raises SyntaxError on
+    unparseable source (callers treat that as 'no summary')."""
+    if tree is None:
+        tree = ast.parse(source)
+    return _ModuleSummarizer(path, tree).summary()
+
+
+# ---------------------------------------------------------------------------
+# Project graph + content-hash cache
+# ---------------------------------------------------------------------------
+
+
+class ProjectGraph:
+    """Summaries for every file under ``<root>/ray_tpu``, content-hash cached."""
+
+    def __init__(self, root: Path, cache_path: Optional[Path] = None,
+                 use_cache: bool = True):
+        self.root = Path(root)
+        self.cache_path = cache_path
+        self.use_cache = use_cache
+        self.summaries: Dict[str, dict] = {}
+        self.shas: Dict[str, str] = {}
+        self.by_modname: Dict[str, str] = {}
+        self.stats = {"files": 0, "parsed": 0, "cache_hits": 0,
+                      "build_seconds": 0.0}
+        self._build()
+
+    def _load_cache(self) -> Dict[str, dict]:
+        if not self.use_cache or self.cache_path is None \
+                or not self.cache_path.is_file():
+            return {}
+        try:
+            doc = json.loads(self.cache_path.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            return {}
+        if doc.get("version") != GRAPH_SCHEMA_VERSION:
+            return {}
+        files = doc.get("files")
+        return files if isinstance(files, dict) else {}
+
+    def _save_cache(self):
+        if not self.use_cache or self.cache_path is None:
+            return
+        doc = {
+            "comment": "raylint graph cache: per-file call-graph summaries "
+                       "keyed by content hash. Safe to delete; never commit.",
+            "version": GRAPH_SCHEMA_VERSION,
+            "files": {p: {"sha": self.shas[p], "summary": s}
+                      for p, s in self.summaries.items()},
+        }
+        tmp = self.cache_path.with_suffix(".tmp")
+        try:
+            tmp.write_text(json.dumps(doc, sort_keys=True), encoding="utf-8")
+            os.replace(tmp, self.cache_path)
+        except OSError:
+            pass  # cache is an optimization; never fail the lint over it
+
+    def _build(self):
+        started = time.perf_counter()
+        cached = self._load_cache()
+        dirty = False
+        tree_root = self.root / "ray_tpu"
+        for file in iter_py_files([tree_root] if tree_root.is_dir() else []):
+            try:
+                rel = file.resolve().relative_to(self.root.resolve()).as_posix()
+            except ValueError:
+                rel = file.as_posix()
+            try:
+                source = file.read_text(encoding="utf-8")
+            except (OSError, UnicodeDecodeError):
+                continue
+            sha = _sha(source)
+            self.stats["files"] += 1
+            entry = cached.get(rel)
+            if entry and entry.get("sha") == sha:
+                self.summaries[rel] = entry["summary"]
+                self.shas[rel] = sha
+                self.stats["cache_hits"] += 1
+                continue
+            try:
+                self.summaries[rel] = summarize_module(rel, source)
+            except SyntaxError:
+                continue  # E999 is reported by the core runner
+            self.shas[rel] = sha
+            self.stats["parsed"] += 1
+            dirty = True
+        if dirty or (cached and set(cached) != set(self.summaries)):
+            self._save_cache()
+        for rel, summary in self.summaries.items():
+            self.by_modname[summary["modname"]] = rel
+        self.stats["build_seconds"] = time.perf_counter() - started
+
+
+def project_graph(project) -> ProjectGraph:
+    """The (cached-per-run) ProjectGraph for a raylint ``Project``. The
+    on-disk cache lives under the PROJECT's tools/raylint/ (so a test
+    project rooted in tmp_path never clobbers the repo's cache); roots
+    without that directory run cache-less."""
+    g = project.cache.get("graph")
+    if g is None:
+        cache_dir = Path(project.root) / "tools" / "raylint"
+        cache_path = (cache_dir / DEFAULT_CACHE_NAME) if cache_dir.is_dir() \
+            else None
+        use_cache = not os.environ.get("RAYLINT_NO_GRAPH_CACHE")
+        g = ProjectGraph(project.root, cache_path=cache_path,
+                         use_cache=use_cache)
+        project.cache["graph"] = g
+    return g
+
+
+# ---------------------------------------------------------------------------
+# GraphView: resolution + interprocedural queries
+# ---------------------------------------------------------------------------
+
+FuncKey = Tuple[str, str]  # (path, qualname)
+
+
+class GraphView:
+    """Project graph plus an optional overlay module (the module currently
+    being linted, summarized from its in-memory AST)."""
+
+    def __init__(self, graph: ProjectGraph, overlay: Optional[dict] = None):
+        self.graph = graph
+        self.overlay = overlay
+        self._modules: Dict[str, dict] = dict(graph.summaries)
+        self._by_modname = dict(graph.by_modname)
+        if overlay is not None:
+            self._modules[overlay["path"]] = overlay
+            self._by_modname[overlay["modname"]] = overlay["path"]
+        self._blocking_memo: Dict[FuncKey, Optional[tuple]] = {}
+        self._acq_memo: Dict[FuncKey, Dict[str, tuple]] = {}
+
+    # -- plumbing -----------------------------------------------------------
+
+    def modules(self) -> Iterable[Tuple[str, dict]]:
+        return self._modules.items()
+
+    def module(self, path: str) -> Optional[dict]:
+        return self._modules.get(path)
+
+    def func(self, key: FuncKey) -> Optional[dict]:
+        mod = self._modules.get(key[0])
+        if mod is None:
+            return None
+        return mod["functions"].get(key[1])
+
+    def is_pristine(self, path: str, source: str) -> bool:
+        """True when the module content matches the on-disk graph summary,
+        so global analyses memoized without an overlay stay valid."""
+        sha = self.graph.shas.get(path)
+        return sha is not None and sha == _sha(source)
+
+    # -- name resolution ----------------------------------------------------
+
+    def _method_on_class(self, mod: dict, cls_name: str, meth: str,
+                         _depth: int = 0) -> Optional[FuncKey]:
+        cls = mod["classes"].get(cls_name)
+        if cls is None:
+            return None
+        if meth in cls["methods"]:
+            return (mod["path"], f"{cls_name}.{meth}")
+        if _depth >= 4:
+            return None
+        for base in cls["bases"]:
+            if "." not in base:
+                found = self._method_on_class(mod, base, meth, _depth + 1)
+                if found:
+                    return found
+            else:
+                bmod_name, _, bcls = base.rpartition(".")
+                bpath = self._by_modname.get(bmod_name)
+                if bpath:
+                    found = self._method_on_class(
+                        self._modules[bpath], bcls, meth, _depth + 1)
+                    if found:
+                        return found
+        return None
+
+    def _dotted_target(self, dotted: str) -> Optional[FuncKey]:
+        """``pkg.mod.fn`` or ``pkg.mod.Class.method`` -> FuncKey."""
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod_name = ".".join(parts[:cut])
+            path = self._by_modname.get(mod_name)
+            if path is None:
+                continue
+            mod = self._modules[path]
+            rest = parts[cut:]
+            if len(rest) == 1:
+                if rest[0] in mod["functions"]:
+                    return (path, rest[0])
+                if rest[0] in mod["classes"]:  # constructor
+                    init = f"{rest[0]}.__init__"
+                    if init in mod["functions"]:
+                        return (path, init)
+                return None
+            if len(rest) == 2:
+                return self._method_on_class(mod, rest[0], rest[1])
+            return None
+        return None
+
+    def resolve_call(self, path: str, func: dict, call: dict) -> Optional[FuncKey]:
+        """Resolve one recorded call site to a project function, or None."""
+        raw = call.get("raw")
+        mod = self._modules.get(path)
+        if mod is None or raw is None:
+            return None
+        if raw.startswith("self."):
+            rest = raw[5:]
+            if "." in rest or func.get("cls") is None:
+                return None  # attribute hop / not a method
+            return self._method_on_class(mod, func["cls"], rest)
+        if raw.startswith("cls."):
+            rest = raw[4:]
+            if "." in rest or func.get("cls") is None:
+                return None
+            return self._method_on_class(mod, func["cls"], rest)
+        if "." not in raw:
+            nested = f"{func['qual']}.{raw}"
+            if nested in mod["functions"]:
+                return (path, nested)
+            if raw in mod["functions"]:
+                return (path, raw)
+            if raw in mod["classes"]:
+                init = f"{raw}.__init__"
+                if init in mod["functions"]:
+                    return (path, init)
+            return None
+        # fully-dotted (alias-expanded) name; also ClassName.method in-module
+        head, _, meth = raw.partition(".")
+        if head in mod["classes"] and "." not in meth:
+            found = self._method_on_class(mod, head, meth)
+            if found:
+                return found
+        return self._dotted_target(raw)
+
+    # -- interprocedural queries --------------------------------------------
+
+    def blocking_chain(self, key: FuncKey) -> Optional[tuple]:
+        """If the SYNC function at ``key`` (transitively) makes a blocking
+        call, return ``(chain, what, hint)`` where chain is a list of
+        ``(path, qual, line)`` hops ending at the blocking call site."""
+        return self._blocking_chain(key, set(), 0)[0]
+
+    def _blocking_chain(self, key: FuncKey, stack: Set[FuncKey],
+                        depth: int) -> Tuple[Optional[tuple], bool]:
+        """(result, tainted). A result computed under a pruned traversal —
+        a recursion-cycle hit or the depth cap — is ``tainted`` and must
+        NOT be memoized as a definitive None: a different entry point may
+        reach the same node with a live path the pruned one couldn't see.
+        A FOUND chain is always valid and always cacheable."""
+        if key in self._blocking_memo:
+            return self._blocking_memo[key], False
+        func = self.func(key)
+        if func is None or func["is_async"]:
+            return None, False
+        if key in stack or depth > 12:
+            return None, True
+        stack.add(key)
+        tainted = False
+        result = None
+        if func["blocking"]:
+            b = func["blocking"][0]
+            result = ([(key[0], key[1], b["line"])], b["what"], b["hint"])
+        else:
+            for call in func["calls"]:
+                target = self.resolve_call(key[0], func, call)
+                if target is None or target == key:
+                    continue
+                tf = self.func(target)
+                if tf is None or tf["is_async"]:
+                    continue
+                sub, sub_tainted = self._blocking_chain(target, stack,
+                                                        depth + 1)
+                tainted |= sub_tainted
+                if sub is not None:
+                    chain = [(key[0], key[1], call["line"])] + sub[0]
+                    result = (chain, sub[1], sub[2])
+                    break
+        stack.discard(key)
+        if result is not None or not tainted:
+            self._blocking_memo[key] = result
+        return result, tainted and result is None
+
+    def transitive_acquires(self, key: FuncKey) -> Dict[str, tuple]:
+        """All ``with``-style lock acquisitions reachable from ``key``
+        (itself included), as ``{lock_id: (path, line)}``."""
+        return self._transitive_acquires(key, set(), 0)[0]
+
+    def _transitive_acquires(self, key: FuncKey, stack: Set[FuncKey],
+                             depth: int) -> Tuple[Dict[str, tuple], bool]:
+        """(acquisitions, tainted). Same memo discipline as
+        ``_blocking_chain``: a set computed under a pruned traversal is a
+        valid under-approximation for the CALLER's use but must not be
+        cached as this node's definitive answer."""
+        if key in self._acq_memo:
+            return self._acq_memo[key], False
+        func = self.func(key)
+        if func is None:
+            return {}, False
+        if key in stack or depth > 6:
+            return {}, True
+        stack.add(key)
+        tainted = False
+        out: Dict[str, tuple] = {}
+        for lock, line in func["acquires"]:
+            out.setdefault(lock, (key[0], line))
+        for call in func["calls"]:
+            target = self.resolve_call(key[0], func, call)
+            if target is None or target == key:
+                continue
+            sub, sub_tainted = self._transitive_acquires(target, stack,
+                                                         depth + 1)
+            tainted |= sub_tainted
+            for lock, site in sub.items():
+                out.setdefault(lock, site)
+        stack.discard(key)
+        if not tainted:
+            self._acq_memo[key] = out
+        return out, tainted
+
+    def net_lock_effects(self, key: FuncKey) -> Tuple[Set[str], Set[str]]:
+        """Flow-insensitive ``.acquire()``/``.release()`` balance for one
+        function: (locks it acquires and does not release, locks it
+        releases). Used by AWT002's one-level call inlining."""
+        func = self.func(key)
+        if func is None:
+            return set(), set()
+        acq = [l for l, _ in func["acq_calls"]]
+        rel = {l for l, _ in func["rel_calls"]}
+        return {l for l in acq if l not in rel}, rel
+
+    def lock_graph(self, scope_paths: Optional[Sequence[str]] = None
+                   ) -> Dict[Tuple[str, str], tuple]:
+        """The global lock-acquisition-order graph: edge (A, B) when B is
+        acquired while A is held — via ``with`` nesting in one function or
+        across resolved call edges. Value is the anchoring (path, line)."""
+        edges: Dict[Tuple[str, str], tuple] = {}
+        for path, mod in self.modules():
+            if scope_paths is not None and not any(
+                    path.startswith(p) for p in scope_paths):
+                continue
+            for func in mod["functions"].values():
+                for a, b, line in func["lock_edges"]:
+                    edges.setdefault((a, b), (path, line))
+                for call in func["calls"]:
+                    if not call["held"]:
+                        continue
+                    target = self.resolve_call(path, func, call)
+                    if target is None:
+                        continue
+                    for lock, _site in self.transitive_acquires(target).items():
+                        for held in call["held"]:
+                            edges.setdefault((held, lock),
+                                             (path, call["line"]))
+        return edges
+
+    def rlock_ids(self) -> Set[str]:
+        out: Set[str] = set()
+        for _, mod in self.modules():
+            out.update(mod.get("rlocks", ()))
+        return out
+
+    # -- RPC parity universe -------------------------------------------------
+
+    def rpc_handlers(self) -> Dict[str, List[tuple]]:
+        out: Dict[str, List[tuple]] = {}
+        for path, mod in self.modules():
+            for name, line in mod["rpc_handlers"]:
+                out.setdefault(name, []).append((path, line))
+            for name, line in mod["rpc_dispatch"]:
+                out.setdefault(name, []).append((path, line))
+        return out
+
+    def rpc_calls(self) -> Dict[str, List[tuple]]:
+        """Wire-method mentions at call sites: literal first args to
+        call/notify/wrappers, literals reaching a ``method`` variable used as
+        first arg, and literals passed to a resolved callee's ``method``
+        parameter."""
+        out: Dict[str, List[tuple]] = {}
+
+        def note(name: str, path: str, line: int):
+            out.setdefault(name, []).append((path, line))
+
+        for path, mod in self.modules():
+            for func in mod["functions"].values():
+                # literal strings (possibly via if/else) assigned to locals
+                var_literals = self._var_literals(path, func)
+                for call in func["calls"]:
+                    raw = call.get("raw") or ""
+                    attr = call.get("attr")
+                    term = attr if attr is not None else raw.rsplit(".", 1)[-1]
+                    direct = (term in _RPC_CALL_TERMINALS
+                              or term.endswith("_call"))
+                    lits = call.get("lit", ())
+                    if direct:
+                        for pos, value in lits:
+                            if pos == 0:
+                                note(value, path, call["line"])
+                        var0 = call.get("var0")
+                        if var0 and var0 in var_literals:
+                            for value in var_literals[var0]:
+                                note(value, path, call["line"])
+                    elif lits:
+                        # resolved callee with a `method` parameter: the
+                        # literal at that position is a wire-method mention
+                        target = self.resolve_call(path, func, call)
+                        tf = self.func(target) if target else None
+                        if tf and "method" in tf["params"]:
+                            idx = tf["params"].index("method")
+                            if raw.startswith(("self.", "cls.")) \
+                                    and tf["params"][:1] == ["self"]:
+                                idx -= 1
+                            for pos, value in lits:
+                                if pos == idx:
+                                    note(value, path, call["line"])
+        return out
+
+    @staticmethod
+    def _var_literals(path: str, func: dict) -> Dict[str, List[str]]:
+        return func.get("var_literals", {})

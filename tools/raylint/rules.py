@@ -26,6 +26,16 @@ suppression guidance per rule.
 * LCK001 — lock-order inversion across the GCS -> raylet -> core-worker
   hierarchy: nesting tiered locks against the call direction is the ABBA
   deadlock that wedges a whole node's control plane.
+* SUP001 — stale suppression: a ``# raylint: disable=RULE`` comment that
+  suppresses zero findings (the code it excused was fixed or moved). Dead
+  directives accumulate and silently excuse FUTURE regressions on that
+  line; delete them, or add ``SUP001`` to the directive's rule list with a
+  reason to keep one deliberately dormant. (Detection lives in core.py —
+  it needs the pre-suppression finding set; the class below is the
+  registry marker so ``--rules``/``--list-rules`` see it.)
+
+The interprocedural rules (ASY004, LCK002, AWT002, WIRE002) live in
+``tools/raylint/rules_interp.py`` on top of the graph/flow layers.
 """
 
 from __future__ import annotations
@@ -735,6 +745,22 @@ class UnregisteredWireStruct(Rule):
                     f"wire.py (_register_builtin_types); register it, or mark "
                     f"it process-local with `# raylint: disable=WIRE001 <why>`"))
         return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# SUP001 — stale suppressions (marker class; detection in core.check_source)
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class StaleSuppression(Rule):
+    name = "SUP001"
+    summary = ("`# raylint: disable=RULE` that suppresses zero findings: "
+               "dead directives excuse future regressions; delete them (or "
+               "add SUP001 to the directive's rule list to keep it)")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        return iter(())  # core.check_source runs the real detection
 
 
 # ---------------------------------------------------------------------------

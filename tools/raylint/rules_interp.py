@@ -1,0 +1,521 @@
+"""raylint interprocedural rule set: whole-program invariants.
+
+These rules run on the graph layer (tools/raylint/graph.py) and the flow
+layer (tools/raylint/flow.py) instead of single-file AST patterns:
+
+* ASY004 — blocking call *transitively* reachable from an ``async def``
+  through a chain of sync helpers. Generalizes ASY001, which only sees the
+  direct call: ``async def handler`` -> ``self._sync_helper()`` ->
+  ``_do_io()`` -> ``time.sleep`` stalls the event loop just the same.
+* LCK002 — lock-order cycle in the *global* lock-acquisition graph, built
+  from ``with <lock>:`` nesting within functions and across resolved call
+  edges. Generalizes LCK001's hand-tiered GCS -> raylet -> core-worker
+  direction to every lock on the control/weight/checkpoint/serve planes:
+  any cycle (including a non-reentrant lock re-acquired through a helper —
+  a self-deadlock) fails the lint.
+* AWT002 — ``await`` while holding a lock, flow-sensitively: the held-lock
+  set is propagated across intraprocedural CFG paths (``.acquire()`` /
+  ``.release()``; aliases resolved via reaching definitions) and through one
+  level of call inlining (a helper whose net effect is to leave a lock
+  held). ASY002 only sees ``await`` lexically inside ``with <lock>:``.
+* WIRE002 — wire-schema drift: for every ``register_struct`` entry in
+  ``_private/wire.py``, encoded-field list vs decode-lambda reads vs the
+  struct's actual fields must agree; and every RPC method must have both a
+  client call site and a server handler (``_rpc_X`` or a ``method == "X"``
+  dispatcher arm) somewhere in the tree — a one-sided add is a lint
+  failure, not a runtime KeyError on a 16-node stress run.
+
+Per-module reporting: each rule computes whole-program facts once (memoized
+on the shared graph view) and emits only the findings that anchor in the
+module currently being checked, so baseline/suppression semantics stay
+file-local like every other raylint rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from tools.raylint import flow
+from tools.raylint import graph as graphmod
+from tools.raylint.core import Finding, Module, Rule, register_rule
+from tools.raylint.graph import GraphView, summarize_module
+from tools.raylint.rules import _is_lock_like
+
+# paths (relative to repo root) whose locks participate in LCK002
+_LCK_SCOPE = ("ray_tpu/_private/", "ray_tpu/weights/", "ray_tpu/ckpt/",
+              "ray_tpu/serve/")
+
+
+def _interp_state(module: Module) -> Tuple[Optional[GraphView], Optional[dict]]:
+    """(GraphView, this module's summary). Pristine modules (content matches
+    the on-disk graph) share one view so interprocedural memos persist
+    across the whole run; fixtures get an overlay view with their fresh
+    AST layered over the project graph."""
+    project = module.project
+    g = graphmod.project_graph(project)
+    pristine_view: GraphView = project.cache.get("interp.view")
+    if pristine_view is None:
+        pristine_view = GraphView(g)
+        project.cache["interp.view"] = pristine_view
+    if pristine_view.is_pristine(module.path, module.source):
+        return pristine_view, pristine_view.module(module.path)
+    cache_key = ("interp.overlay", module.path, hash(module.source))
+    cached = project.cache.get(cache_key)
+    if cached is not None:
+        return cached
+    try:
+        summary = summarize_module(module.path, module.source, module.tree)
+    except SyntaxError:
+        project.cache[cache_key] = (None, None)
+        return None, None
+    view = GraphView(g, overlay=summary)
+    project.cache[cache_key] = (view, summary)
+    return view, summary
+
+
+def _fmt_chain(chain: List[tuple]) -> str:
+    return " -> ".join(f"{p}:{q}:{ln}" for p, q, ln in chain)
+
+
+def _lock_display(lock_id: str) -> str:
+    # "ray_tpu._private.gcs:GcsServer._lock" -> "GcsServer._lock"
+    return lock_id.split(":", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# ASY004 — transitively-reachable blocking call from async context
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class TransitiveBlockingCall(Rule):
+    name = "ASY004"
+    summary = ("blocking call reachable from `async def` through sync helper "
+               "chains: stalls the event loop exactly like ASY001, one or "
+               "more calls removed")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        view, summary = _interp_state(module)
+        if view is None or summary is None:
+            return iter(())
+        findings: List[Finding] = []
+        for func in summary["functions"].values():
+            if not func["is_async"]:
+                continue
+            for call in func["calls"]:
+                target = view.resolve_call(module.path, func, call)
+                if target is None:
+                    continue
+                tf = view.func(target)
+                if tf is None or tf["is_async"]:
+                    continue
+                hit = view.blocking_chain(target)
+                if hit is None:
+                    continue
+                chain, what, hint = hit
+                full = [(module.path, func["qual"], call["line"])] + chain
+                findings.append(Finding(
+                    rule=self.name, path=module.path, line=call["line"],
+                    col=0,
+                    message=(f"async `{func['qual']}` reaches blocking "
+                             f"`{what}` through sync helper(s): "
+                             f"{_fmt_chain(full)}; {hint} (or hand the whole "
+                             f"chain to an executor)"),
+                    snippet=module.line(call["line"]).strip()))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# LCK002 — lock-order cycles in the global acquisition graph
+# ---------------------------------------------------------------------------
+
+
+def _tarjan_sccs(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in list(adj):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    n = stack.pop()
+                    on_stack.discard(n)
+                    scc.append(n)
+                    if n == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def _shortest_cycle_via(adj: Dict[str, Set[str]], a: str, b: str,
+                        scc: Set[str]) -> List[str]:
+    """Shortest b -> ... -> a path inside the SCC; the a -> b edge closes it."""
+    if a == b:
+        return [a, a]
+    frontier = [[b]]
+    seen = {b}
+    while frontier:
+        path = frontier.pop(0)
+        for nxt in sorted(adj.get(path[-1], ())):
+            if nxt == a:
+                return [a] + path + [a]
+            if nxt in scc and nxt not in seen:
+                seen.add(nxt)
+                frontier.append(path + [nxt])
+    return [a, b, a]  # unreachable in a true SCC; defensive
+
+
+@register_rule
+class LockOrderCycle(Rule):
+    name = "LCK002"
+    summary = ("cycle in the global lock-acquisition graph (with-nesting "
+               "across call edges): two paths that interleave deadlock — "
+               "covers every lock in _private/, weights/, ckpt/, serve/")
+
+    def _offending_edges(self, view: GraphView):
+        cached = getattr(view, "_lck002_memo", None)
+        if cached is not None:
+            return cached
+        edges = view.lock_graph(_LCK_SCOPE)
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        rlocks = view.rlock_ids()
+        comp: Dict[str, int] = {}
+        scc_sets: List[Set[str]] = []
+        for i, scc in enumerate(_tarjan_sccs(adj)):
+            scc_sets.append(set(scc))
+            for n in scc:
+                comp[n] = i
+        offending = []  # (edge, site, cycle-path)
+        for (a, b), site in sorted(edges.items()):
+            if a == b:
+                if a not in rlocks:
+                    offending.append(((a, b), site, [a, a]))
+            elif comp.get(a) == comp.get(b) \
+                    and len(scc_sets[comp[a]]) >= 2:
+                cycle = _shortest_cycle_via(adj, a, b, scc_sets[comp[a]])
+                offending.append(((a, b), site, cycle))
+        view._lck002_memo = offending
+        return offending
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        view, summary = _interp_state(module)
+        if view is None:
+            return iter(())
+        findings: List[Finding] = []
+        for (a, b), (path, line), cycle in self._offending_edges(view):
+            if path != module.path:
+                continue
+            names = " -> ".join(f"`{_lock_display(n)}`" for n in cycle)
+            if a == b:
+                msg = (f"non-reentrant lock `{_lock_display(a)}` re-acquired "
+                       f"on a path that already holds it (through a helper "
+                       f"call): self-deadlock; make the inner path "
+                       f"lock-free or use an RLock deliberately")
+            else:
+                msg = (f"`{_lock_display(b)}` acquired while holding "
+                       f"`{_lock_display(a)}` closes the lock-order cycle "
+                       f"{names}; pick one global order for these locks and "
+                       f"invert this nesting")
+            findings.append(Finding(
+                rule=self.name, path=module.path, line=line, col=0,
+                message=msg, snippet=module.line(line).strip()))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# AWT002 — await while holding a lock (flow-sensitive, one-level inlining)
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class AwaitHoldingLockFlow(Rule):
+    name = "AWT002"
+    summary = ("`await` while a lock acquired via `.acquire()` (or left held "
+               "by a sync helper) is still held on some path: the loop "
+               "thread parks holding it — ASY002 only sees lexical `with`")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        view, summary = _interp_state(module)
+        if view is None or summary is None:
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                findings.extend(self._check_async_fn(module, view, summary, node))
+        return iter(findings)
+
+    def _check_async_fn(self, module: Module, view: GraphView, summary: dict,
+                        fn: ast.AsyncFunctionDef) -> List[Finding]:
+        func = self._summary_for(summary, fn)
+        if func is None:
+            return []
+        cfg = flow.build_cfg(fn)
+        if not cfg.nodes:
+            return []
+        defs = flow.reaching_defs(cfg)
+        resolver = module.resolver
+        module_locks = _module_lock_names(summary)
+
+        def norm(expr: ast.AST) -> Optional[str]:
+            return graphmod.lock_identity(
+                expr, resolver, summary["modname"], func["cls"],
+                func["qual"], module_locks, aliases={})
+
+        def lock_id_at(expr: ast.AST, stmt_index: int) -> Optional[str]:
+            """Resolve a lock expression, following a local alias through
+            its reaching definitions (all reaching defs must agree)."""
+            if isinstance(expr, ast.Name):
+                reaching = defs.get(stmt_index, {}).get(expr.id)
+                if reaching and all(v is not None for v in reaching):
+                    ids = set()
+                    for value in reaching:
+                        if isinstance(value, (ast.Name, ast.Attribute)) \
+                                and _is_lock_like(value, resolver):
+                            ids.add(norm(value))
+                        else:
+                            return None
+                    if len(ids) == 1:
+                        return ids.pop()
+                return None
+            if isinstance(expr, ast.Attribute) \
+                    and _is_lock_like(expr, resolver):
+                return norm(expr)
+            return None
+
+        index_of = {id(s): i for i, s in enumerate(cfg.nodes)}
+
+        def transfer(stmt: ast.stmt, held: FrozenSet) -> FrozenSet:
+            i = index_of[id(stmt)]
+            out = set(held)
+            awaited_calls = {
+                id(n.value) for n in ast.walk(stmt)
+                if isinstance(n, ast.Await) and isinstance(n.value, ast.Call)}
+            for call in flow.stmt_calls(stmt):
+                if not isinstance(call.func, ast.Attribute):
+                    # maybe a helper with net lock effects
+                    self._apply_helper(module, view, func, call, out)
+                    continue
+                attr = call.func.attr
+                if attr in ("acquire", "release"):
+                    lock = lock_id_at(call.func.value, i)
+                    if lock is None:
+                        continue
+                    if attr == "acquire" and id(call) not in awaited_calls:
+                        out.add(lock)
+                    elif attr == "release":
+                        out.discard(lock)
+                else:
+                    self._apply_helper(module, view, func, call, out)
+            return frozenset(out)
+
+        IN = flow.forward_may(cfg, transfer)
+        findings = []
+        seen_lines: Set[int] = set()
+        for i, stmt in enumerate(cfg.nodes):
+            held = IN[i]
+            if not held:
+                continue
+            for aw in flow.stmt_awaits(stmt):
+                line = getattr(aw, "lineno", stmt.lineno)
+                if line in seen_lines:
+                    continue
+                seen_lines.add(line)
+                locks = ", ".join(sorted(_lock_display(l) for l in held))
+                findings.append(Finding(
+                    rule=self.name, path=module.path, line=line, col=0,
+                    message=(f"await with lock(s) {locks} still held on some "
+                             f"path (acquired via .acquire() or a helper, "
+                             f"not released before awaiting): the event-loop "
+                             f"thread parks holding the lock — release "
+                             f"first, or use asyncio primitives"),
+                    snippet=module.line(line).strip()))
+        return findings
+
+    def _apply_helper(self, module: Module, view: GraphView, func: dict,
+                      call: ast.Call, out: Set[str]):
+        """One level of call inlining: a resolved sync helper's net
+        acquire/release effect lands in the caller's held set."""
+        raw = module.resolver.dotted(call.func)
+        if raw is None:
+            return
+        entry = {"raw": raw, "attr": None, "line": call.lineno, "held": []}
+        target = view.resolve_call(module.path, func, entry)
+        if target is None:
+            return
+        tf = view.func(target)
+        if tf is None or tf["is_async"]:
+            return
+        acquired, released = view.net_lock_effects(target)
+        out.update(acquired)
+        out.difference_update(released)
+
+    @staticmethod
+    def _summary_for(summary: dict, fn: ast.AST) -> Optional[dict]:
+        for func in summary["functions"].values():
+            if func["line"] == fn.lineno and func["is_async"]:
+                return func
+        return None
+
+
+def _module_lock_names(summary: dict) -> Set[str]:
+    """Module-level lock names aren't kept in summaries; recover the common
+    case (module-global `_lock = threading.Lock()`) from the lock ids
+    already recorded, so `lock_identity` normalizes the same at rule time
+    as it did at summary time."""
+    return {
+        lock.split(":", 1)[1]
+        for fq in summary["functions"].values()
+        for lock, _ in fq["acquires"] + fq["acq_calls"]
+        if ":" in lock and "." not in lock.split(":", 1)[1]
+    }
+
+
+# ---------------------------------------------------------------------------
+# WIRE002 — wire-schema drift
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class WireSchemaDrift(Rule):
+    name = "WIRE002"
+    summary = ("wire-schema drift: register_struct field list vs decode "
+               "reads vs struct definition must agree, and every RPC method "
+               "needs both a client call site and a server handler")
+
+    def _universe(self, view: GraphView):
+        cached = getattr(view, "_wire002_memo", None)
+        if cached is None:
+            cached = (view.rpc_handlers(), view.rpc_calls())
+            view._wire002_memo = cached
+        return cached
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        view, summary = _interp_state(module)
+        if view is None or summary is None:
+            return iter(())
+        findings: List[Finding] = []
+        handlers, calls = self._universe(view)
+
+        def add(line: int, message: str):
+            findings.append(Finding(
+                rule=self.name, path=module.path, line=line, col=0,
+                message=message, snippet=module.line(line).strip()))
+
+        # client side: a called method with no handler anywhere
+        own_calls = {}
+        for name, sites in calls.items():
+            for path, line in sites:
+                if path == module.path:
+                    own_calls.setdefault(name, []).append(line)
+        for name, lines in sorted(own_calls.items()):
+            if name in handlers:
+                continue
+            for line in lines:
+                add(line, f"RPC `{name}` is called here but no server "
+                          f"defines a handler for it (`_rpc_{name}` or a "
+                          f"`method == \"{name}\"` dispatcher arm): this "
+                          f"raises at runtime on the first call")
+        # server side: a handler nobody calls
+        own_handlers = [(n, l) for n, l in
+                        summary["rpc_handlers"] + summary["rpc_dispatch"]]
+        for name, line in sorted(own_handlers):
+            if name not in calls:
+                add(line, f"RPC handler `{name}` has no client call site "
+                          f"anywhere in ray_tpu/: dead wire surface — "
+                          f"delete it, or suppress with the reason it "
+                          f"exists (external tooling, test protocol)")
+        # registry parity (wire.py only)
+        if Path(module.path).name == "wire.py":
+            findings.extend(self._registry_findings(module, view, summary))
+        return iter(findings)
+
+    def _registry_findings(self, module: Module, view: GraphView,
+                           summary: dict) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def add(line: int, message: str):
+            findings.append(Finding(
+                rule=self.name, path=module.path, line=line, col=0,
+                message=message, snippet=module.line(line).strip()))
+
+        for entry in summary["wire_registry"]:
+            fields = entry["fields"]
+            decode_fields = entry["decode_fields"]
+            line = entry["line"]
+            cls_raw = entry["cls"] or "<unknown>"
+            cls_name = cls_raw.rsplit(".", 1)[-1]
+            if fields is not None and decode_fields is not None:
+                for missing in sorted(set(decode_fields) - set(fields)):
+                    add(line, f"decode for `{cls_name}` reads field "
+                              f"`{missing}` that is not in its encoded "
+                              f"field list: KeyError on every decoded "
+                              f"message — add it to fields=(...) too")
+                for extra in sorted(set(fields) - set(decode_fields)):
+                    add(line, f"`{cls_name}` encodes field `{extra}` that "
+                              f"its decode never reads: the value is "
+                              f"silently dropped on the receiving side — "
+                              f"read it in decode or stop encoding it")
+            if fields is not None and entry["cls"]:
+                cls_def = self._class_def(view, entry["cls"])
+                if cls_def is not None:
+                    known = set(cls_def["fields"]) | set(cls_def["init_params"])
+                    for f in fields:
+                        if f not in known:
+                            add(line, f"`{cls_name}` has no field or "
+                                      f"constructor parameter `{f}`; the "
+                                      f"encoder would raise AttributeError "
+                                      f"on every send — fix the fields "
+                                      f"tuple or the struct")
+        return findings
+
+    @staticmethod
+    def _class_def(view: GraphView, dotted: str) -> Optional[dict]:
+        parts = dotted.split(".")
+        if len(parts) < 2:
+            return None
+        for cut in range(len(parts) - 1, 0, -1):
+            mod_name = ".".join(parts[:cut])
+            path = view._by_modname.get(mod_name)
+            if path is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                return view._modules[path]["classes"].get(rest[0])
+            return None
+        return None
