@@ -161,6 +161,23 @@ _d("task_events_max_per_task", 64)  # transition entries kept per task
 _d("gcs_task_event_shards", 8)
 _d("gcs_task_event_ingest_max", 65536)  # queued events per shard; drop beyond
 
+# --- cluster health plane (metrics history + health monitor) ---
+# two-tier metrics time-series ring kept by the GCS over the snapshots it
+# already receives: a raw tier sampled every metrics_history_interval_s and
+# a rollup tier aggregating raw points every metrics_history_rollup_s
+_d("metrics_history_interval_s", 5.0)
+_d("metrics_history_raw_points", 360)     # ~30 min of raw tier
+_d("metrics_history_rollup_s", 60.0)
+_d("metrics_history_rollup_points", 1440)  # ~24 h of rollup tier
+# GCS health monitor: scans task events + metrics for stuck tasks,
+# straggler nodes, and dead-zygote/pool starvation
+_d("health_scan_interval_s", 5.0)
+_d("health_stuck_min_s", 30.0)       # floor: RUNNING younger is never stuck
+_d("health_stuck_p99_factor", 5.0)   # stuck if age > factor * per-fn p99
+_d("health_stuck_fallback_s", 600.0)  # no completed samples for the fn yet
+_d("health_straggler_factor", 3.0)   # outlier if > factor * cluster median
+_d("health_warn_interval_s", 60.0)   # rate limit for health warning logs
+
 # --- train / libs ---
 _d("train_health_check_period_s", 1.0)
 _d("serve_proxy_port", 8000)

@@ -1716,7 +1716,9 @@ class CoreWorker:
             record["_job_hex"] = jh = self.job_id.hex()
             task_events.record(task_id.hex(), task_events.SUBMITTED,
                                name=record["name"], job_id=jh,
-                               arg_bytes=len(args_blob))
+                               arg_bytes=len(args_blob),
+                               span_id=_task_span_id(spec),
+                               parent_span=self._submitter_span())
         for oid, owner in arg_refs:
             self.ref_counter.pin(oid, owner)
         record["_pinned"] = True
@@ -1740,6 +1742,17 @@ class CoreWorker:
 
             return ObjectRefGenerator(self, task_id, self.address)
         return refs[0] if nret == 1 else refs
+
+    def _submitter_span(self) -> str:
+        """The submitter's active span id (the enclosing task's execution
+        span, or a user ``profile()`` block) — rides the SUBMITTED task
+        event so the GCS timeline can join parent→child task records into
+        flow arrows without reading the span table. Empty when tracing is
+        off (arrows are a tracing feature; slices still render)."""
+        from ray_tpu.util import tracing
+
+        ctx = tracing.current_context()
+        return ctx[1] if ctx is not None else ""
 
     def _stamp_trace(self, spec: TaskSpec, name: str):
         """Propagate the caller's trace context into the spec (reference:
@@ -2155,7 +2168,9 @@ class CoreWorker:
             record["_job_hex"] = jh = self.job_id.hex()
             task_events.record(task_id.hex(), task_events.SUBMITTED,
                                name=record["name"], job_id=jh,
-                               arg_bytes=len(args_blob))
+                               arg_bytes=len(args_blob),
+                               span_id=_task_span_id(spec),
+                               parent_span=self._submitter_span())
         for oid, owner in arg_refs:
             self.ref_counter.pin(oid, owner)
         record["_pinned"] = True
@@ -3058,7 +3073,8 @@ class CoreWorker:
             task_events.record(
                 spec.task_id.hex(), task_events.RUNNING,
                 attempt=spec.attempt, job_id=spec.job_id.hex(),
-                worker=self.address, node=self.node_hex)
+                worker=self.address, node=self.node_hex,
+                span_id=_task_span_id(spec))
         return self._install_trace(spec)
 
     def _obs_task_end(self, token):

@@ -20,11 +20,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import pickle
+import threading
 
 from ray_tpu._private import wire
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private.common import (
     Bundle,
@@ -36,7 +37,7 @@ from ray_tpu._private.common import (
 )
 from ray_tpu._private.config import RAY_CONFIG
 from ray_tpu._private.async_util import spawn
-from ray_tpu._private.task_events import TERMINAL_STATES
+from ray_tpu._private.task_events import RUNNING, TERMINAL_STATES
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.rpc import RpcError, RpcServer, RetryingRpcClient, ServerConnection
 from ray_tpu._private.store_client import make_store
@@ -175,6 +176,7 @@ class GcsTaskManager:
                     "task_id": tid, "job_id": job, "name": "", "state": "",
                     "attempt": 0, "error": "", "worker": "", "node": "",
                     "arg_bytes": 0, "ret_bytes": 0,
+                    "span_id": "", "parent_span": "",
                     "events": [], "_last_ts": 0.0,
                 }
             self._merge(rec, ev)
@@ -195,6 +197,13 @@ class GcsTaskManager:
             del events[: len(events) - self.max_events_per_task]
         if ev.get("name"):
             rec["name"] = ev["name"]
+        # causal linkage for the timeline: the task's deterministic
+        # execution-span id and the submitter's active span (latest
+        # non-empty wins, so a retry's span supersedes attempt 0's)
+        if ev.get("span_id"):
+            rec["span_id"] = ev["span_id"]
+        if ev.get("parent_span"):
+            rec["parent_span"] = ev["parent_span"]
         if ev.get("worker"):
             rec["worker"] = ev["worker"]
         if ev.get("node"):
@@ -275,13 +284,21 @@ class GcsTaskManager:
 
 
 class ShardedTaskEvents:
-    """Sharded + pipelined front for ``GcsTaskManager``.
+    """Sharded + pipelined front for ``GcsTaskManager``, with the merge
+    work OFF the GCS event loop.
 
     5k+ tasks/s of lifecycle events must not serialize on one merge path:
     ``AddTaskEvents`` routes each event by task-id hash into one of
-    ``gcs_task_event_shards`` bounded ingest queues and returns immediately;
-    one drain task per shard merges in the background (so a burst costs the
-    caller an enqueue, not a merge), and reads fan out over the shards.
+    ``gcs_task_event_shards`` bounded ingest queues and returns immediately.
+    A dedicated merge THREAD (not an event-loop task — merging 20k queued
+    events inline used to stall heartbeats and lease grants for the whole
+    batch) owns the shard stores exclusively: it drains the queues, and
+    read RPCs hand their query over as a closure (:meth:`read`) that the
+    thread executes against its stores after everything already queued has
+    merged. The handoff is lock-free — single-owner stores, thread-safe
+    deques for the queues and the read requests, results resolved back
+    onto the event loop via ``call_soon_threadsafe`` — so ``ListTasks`` /
+    timeline scrapes never block ingest and ingest never blocks the loop.
     Per-shard rings keep the global per-job bound at
     ``gcs_task_events_max_per_job`` in aggregate."""
 
@@ -290,10 +307,16 @@ class ShardedTaskEvents:
         per_shard_cap = max(1, RAY_CONFIG.gcs_task_events_max_per_job // n)
         self.shards = [GcsTaskManager(max_per_job=per_shard_cap)
                        for _ in range(n)]
+        # deque append/popleft are GIL-atomic: the event loop enqueues,
+        # the merge thread dequeues, no lock needed
         self._queues: List[deque] = [deque() for _ in range(n)]
-        self._wake = [asyncio.Event() for _ in range(n)]
+        self._reporter_drops: deque = deque()  # reporter-side drop counts
+        self._reads: deque = deque()  # (closure, loop|None, future|Event)
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+        self._stopped = False
         self._qmax = max(256, RAY_CONFIG.gcs_task_event_ingest_max)
-        self._flush_rr = 0  # rotating start shard for bounded read flushes
         self.ingest_dropped = 0  # queue-full drops (visible in summarize)
         self.batches = 0  # drained merge batches (pipelining evidence)
 
@@ -310,8 +333,7 @@ class ShardedTaskEvents:
             tid = ev.get("task_id")
             if not tid:
                 continue
-            i = self._shard_of(tid)
-            q = self._queues[i]
+            q = self._queues[self._shard_of(tid)]
             if len(q) >= self._qmax:
                 # drop-OLDEST, matching the store rings: the newest events
                 # carry the terminal FINISHED/FAILED transitions that must
@@ -320,62 +342,118 @@ class ShardedTaskEvents:
                 q.popleft()
                 self.ingest_dropped += 1
             q.append(ev)
-            self._wake[i].set()
         if dropped:
-            self.shards[0].add_events([], dropped)
+            self._reporter_drops.append(int(dropped))
+        if events or dropped:
+            self._ensure_thread()
+            self._wake.set()
 
-    async def drain_loop(self, i: int):
-        """One per shard: merge queued events in batches."""
-        q, wake, shard = self._queues[i], self._wake[i], self.shards[i]
+    # -- merge thread ---------------------------------------------------
+
+    def _ensure_thread(self):
+        t = self._thread
+        if t is not None and t.is_alive():
+            return
+        with self._thread_lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stopped = False
+                self._thread = threading.Thread(
+                    target=self._merge_loop, name="gcs-task-event-merge",
+                    daemon=True)
+                self._thread.start()
+
+    def stop(self):
+        self._stopped = True
+        self._wake.set()
+
+    def _merge_loop(self):
         while True:
-            await wake.wait()
-            wake.clear()
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            try:
+                self._drain_queues()
+            except Exception:
+                logger.exception("task-event merge iteration failed")
+            self._serve_reads()
+            if self._stopped:
+                self._serve_reads()  # don't strand a late read forever
+                return
+
+    def _drain_queues(self):
+        for i, q in enumerate(self._queues):
             while q:
                 batch = []
-                while q and len(batch) < 512:
+                while q and len(batch) < 1024:
                     batch.append(q.popleft())
-                shard.add_events(batch)
+                self.shards[i].add_events(batch)
                 self.batches += 1
-                # yield between batches: reads and other RPCs interleave
-                await asyncio.sleep(0)
+        while self._reporter_drops:
+            self.shards[0].add_events([], self._reporter_drops.popleft())
 
-    def flush_sync(self, max_events: int = 20000):
-        """Read-your-writes for the read RPCs: merge what is queued, but
-        BOUNDED — under a sustained overload the queues can hold hundreds
-        of thousands of events, and merging them all inside one read
-        handler would stall the whole GCS loop (heartbeats, leases). The
-        start shard rotates per call so the budget doesn't systematically
-        favor low-index shards under overload. In the normal case the
-        drain tasks keep queues near-empty and this merges everything."""
-        budget = max_events
-        n = len(self._queues)
-        self._flush_rr = (self._flush_rr + 1) % n
-        for k in range(n):
-            if budget <= 0:
-                break
-            budget -= self.flush_shard((self._flush_rr + k) % n, budget)
+    def _serve_reads(self):
+        while self._reads:
+            try:
+                # read-your-writes: events enqueued BEFORE this read was
+                # posted must be merged before it runs
+                self._drain_queues()
+            except Exception:
+                logger.exception("task-event merge before read failed")
+            fn, loop, fut = self._reads.popleft()
+            try:
+                result, err = fn(self), None
+            except BaseException as e:
+                result, err = None, e
+            if loop is None:  # sync barrier (threading.Event)
+                fut.set()
+                continue
 
-    def flush_shard(self, i: int, budget: int = 20000) -> int:
-        """Merge up to ``budget`` queued events of ONE shard; returns the
-        number merged (get_task only needs its task's shard current)."""
-        q = self._queues[i]
-        batch = []
-        while q and len(batch) < budget:
-            batch.append(q.popleft())
-        if batch:
-            self.shards[i].add_events(batch)
-        return len(batch)
+            def _resolve(fut=fut, result=result, err=err):
+                if fut.cancelled():
+                    return
+                if err is not None:
+                    fut.set_exception(err)
+                else:
+                    fut.set_result(result)
 
-    # -- reads fan out over the shards ---------------------------------
+            try:
+                loop.call_soon_threadsafe(_resolve)
+            except RuntimeError as e:  # loop already closed (shutdown race)
+                logger.debug("task-event read resolve dropped: %s", e)
+
+    async def read(self, fn: Callable[["ShardedTaskEvents"], Any]):
+        """Run ``fn(self)`` on the merge thread, after everything already
+        enqueued has merged (read-your-writes), and await the result
+        WITHOUT blocking the caller's event loop — heartbeats and ingest
+        proceed while the merge thread works."""
+        self._ensure_thread()
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._reads.append((fn, loop, fut))
+        self._wake.set()
+        return await fut
+
+    def flush_sync(self, max_events: int = 0):
+        """Synchronous read barrier for callers OUTSIDE the GCS event loop
+        (tests, tools): returns once everything currently queued has
+        merged. With no merge thread running (directly-constructed stores
+        in unit tests) the merge runs inline on the caller."""
+        t = self._thread
+        if t is None or not t.is_alive():
+            self._drain_queues()
+            return
+        done = threading.Event()
+        self._reads.append((lambda _tm: None, None, done))
+        self._wake.set()
+        done.wait(timeout=30.0)
+
+    # -- reads fan out over the shards (call via read()/flush_sync) -----
 
     def add_events(self, events: List[dict], dropped: int = 0):
-        """Synchronous compatibility path (bypasses the ingest queues)."""
-        for ev in events:
-            tid = ev.get("task_id")
-            if tid:
-                self.shards[self._shard_of(tid)].add_events([ev])
-        if dropped:
-            self.shards[0].add_events([], dropped)
+        """Synchronous compatibility path: enqueue + barrier (the shard
+        stores belong to the merge thread; writing them directly from the
+        caller would race it)."""
+        self.ingest(events, dropped)
+        self.flush_sync()
 
     def list_tasks(self, job_id=None, name=None, state=None,
                    limit: int = 200) -> List[dict]:
@@ -412,6 +490,298 @@ class ShardedTaskEvents:
         return {"per_function": per_fn, "per_function_bytes": sizes,
                 "total": total, "dropped": dropped,
                 "shards": len(self.shards), "merge_batches": self.batches}
+
+
+class MetricsHistory:
+    """Bounded two-tier time-series ring over the cluster's metric
+    snapshots.
+
+    The GCS already receives every process's registry snapshot (the
+    core-worker/raylet auto-flush KV puts into ns ``metrics``); before
+    this class, ``/metrics`` could only serve the LATEST values. Here the
+    latest per-process payloads are aggregated cluster-wide on a sampling
+    cadence into a raw ring (``metrics_history_interval_s``, default 5 s)
+    and periodically rolled up into a coarser ring
+    (``metrics_history_rollup_s``, default 60 s: avg/min/max for gauges,
+    cumulative-last + rate for counters and histograms — histogram samples
+    keep the full bucket vector so percentiles-over-time come from bucket
+    deltas). Surfaced via the ``GetMetricsHistory`` RPC,
+    ``util.state.metrics_history`` and ``GET /api/metrics/history``."""
+
+    STALE_S = 120.0  # ignore process snapshots older than this
+
+    def __init__(self, raw_interval_s: Optional[float] = None,
+                 raw_points: Optional[int] = None,
+                 rollup_interval_s: Optional[float] = None,
+                 rollup_points: Optional[int] = None):
+        self.raw_interval_s = (raw_interval_s
+                               or RAY_CONFIG.metrics_history_interval_s)
+        self.raw_points = raw_points or RAY_CONFIG.metrics_history_raw_points
+        self.rollup_interval_s = (rollup_interval_s
+                                  or RAY_CONFIG.metrics_history_rollup_s)
+        self.rollup_points = (rollup_points
+                              or RAY_CONFIG.metrics_history_rollup_points)
+        self._procs: Dict[str, dict] = {}  # kv key -> latest proc payload
+        self._raw: Dict[str, deque] = {}
+        self._rollup: Dict[str, deque] = {}
+        self._kinds: Dict[str, str] = {}
+        self._last_rollup = 0.0
+        self.samples = 0
+
+    # -- ingestion ------------------------------------------------------
+
+    def observe_payload(self, key: str, payload: dict):
+        """Feed one process's registry snapshot (called on every KV put
+        into the ``metrics`` namespace — no new reporting path)."""
+        if isinstance(payload, dict) and "metrics" in payload:
+            self._procs[key] = payload
+
+    def _fresh_procs(self, now: float) -> List[dict]:
+        stale = [k for k, p in self._procs.items()
+                 if now - p.get("time", 0) > self.STALE_S]
+        for k in stale:
+            del self._procs[k]
+        return list(self._procs.values())
+
+    def latest_by_node(self, name: str) -> Dict[str, float]:
+        """Latest per-node value of a gauge (max across a node's processes
+        and tag sets) — the health monitor's straggler-outlier view."""
+        out: Dict[str, float] = {}
+        now = time.time()
+        for p in self._fresh_procs(now):
+            m = p.get("metrics", {}).get(name)
+            if not m or m.get("kind") != "gauge":
+                continue
+            vals = [v for v in m.get("data", {}).values()
+                    if isinstance(v, (int, float))]
+            if not vals:
+                continue
+            node = str(p.get("node", ""))[:16]
+            out[node] = max(out.get(node, float("-inf")), max(vals))
+        return out
+
+    # -- sampling -------------------------------------------------------
+
+    def _aggregate(self, now: float) -> Dict[str, dict]:
+        """Cluster-wide aggregate per metric name across all fresh process
+        snapshots and tag sets: counters sum; gauges sum + max + process
+        count; histograms sum counts/sums and element-wise bucket rows."""
+        agg: Dict[str, dict] = {}
+        for p in self._fresh_procs(now):
+            for name, m in p.get("metrics", {}).items():
+                kind = m.get("kind")
+                data = m.get("data", {})
+                self._kinds[name] = kind
+                if kind == "counter":
+                    s = agg.setdefault(name, {"value": 0.0})
+                    s["value"] += sum(v for v in data.values()
+                                      if isinstance(v, (int, float)))
+                elif kind == "gauge":
+                    vals = [v for v in data.values()
+                            if isinstance(v, (int, float))]
+                    if not vals:
+                        continue
+                    s = agg.setdefault(
+                        name, {"value": 0.0, "max": float("-inf"), "n": 0})
+                    s["value"] += sum(vals)
+                    s["max"] = max(s["max"], max(vals))
+                    s["n"] += 1
+                elif kind == "histogram":
+                    bounds = list(data.get("boundaries") or [])
+                    s = agg.setdefault(name, {
+                        "count": 0, "sum": 0.0,
+                        "buckets": [0] * (len(bounds) + 1),
+                        "boundaries": bounds})
+                    for counts in data.get("counts", {}).values():
+                        s["count"] += sum(counts)
+                        if len(counts) == len(s["buckets"]):
+                            for i, c in enumerate(counts):
+                                s["buckets"][i] += c
+                    s["sum"] += sum(v for v in data.get("sums", {}).values()
+                                    if isinstance(v, (int, float)))
+        return agg
+
+    def sample(self, now: Optional[float] = None):
+        """Append one raw-tier point per metric (called every
+        ``raw_interval_s`` by the GCS sampling loop), rolling the coarse
+        tier up when its interval has elapsed."""
+        now = time.time() if now is None else now
+        self.samples += 1
+        for name, s in self._aggregate(now).items():
+            ring = self._raw.get(name)
+            if ring is None:
+                ring = self._raw[name] = deque(maxlen=self.raw_points)
+            ring.append({"ts": now, **s})
+        if now - self._last_rollup >= self.rollup_interval_s:
+            self._last_rollup = now
+            self._roll(now)
+
+    def _roll(self, now: float):
+        for name, ring in self._raw.items():
+            window = [p for p in ring
+                      if p["ts"] > now - self.rollup_interval_s]
+            if not window:
+                continue
+            kind = self._kinds.get(name, "gauge")
+            first, last = window[0], window[-1]
+            span = max(last["ts"] - first["ts"], 1e-9)
+            point: Dict[str, Any] = {"ts": now, "n_raw": len(window)}
+            if kind == "gauge":
+                # avg/min/max of the cluster-summed series (raw samples'
+                # per-process "max" is a different axis — mixing it in
+                # would let max < value on multi-process gauges)
+                vals = [p["value"] for p in window]
+                point["value"] = sum(vals) / len(vals)
+                point["min"] = min(vals)
+                point["max"] = max(vals)
+            elif kind == "counter":
+                point["value"] = last["value"]
+                # clamped at 0: the cluster value is a sum over the CURRENT
+                # membership, so a process exiting (or stale-pruned) drops
+                # its lifetime total from the series — that step down is a
+                # membership change, not negative throughput
+                point["rate"] = (max(0.0, last["value"] - first["value"])
+                                 / span if len(window) > 1 else 0.0)
+            else:  # histogram: cumulative last + observation rate
+                point["count"] = last["count"]
+                point["sum"] = last["sum"]
+                point["buckets"] = list(last.get("buckets") or ())
+                point["boundaries"] = list(last.get("boundaries") or ())
+                point["rate"] = (max(0.0, last["count"] - first["count"])
+                                 / span if len(window) > 1 else 0.0)
+            ring2 = self._rollup.get(name)
+            if ring2 is None:
+                ring2 = self._rollup[name] = deque(maxlen=self.rollup_points)
+            ring2.append(point)
+
+    # -- reads ----------------------------------------------------------
+
+    def names(self) -> List[str]:
+        return sorted(self._raw.keys())
+
+    def series(self, name: str, window_s: Optional[float] = None,
+               tier: str = "auto", now: Optional[float] = None) -> dict:
+        """One metric's time series. ``tier="auto"`` picks raw while the
+        requested window still fits in the raw ring, else rollup."""
+        now = time.time() if now is None else now
+        if tier not in ("raw", "rollup", "auto"):
+            tier = "auto"
+        if tier == "auto":
+            raw_span = self.raw_interval_s * self.raw_points
+            tier = ("raw" if window_s is None or window_s <= raw_span
+                    else "rollup")
+        ring = (self._raw if tier == "raw" else self._rollup).get(name)
+        points = list(ring) if ring else []
+        if window_s:
+            cutoff = now - window_s
+            points = [p for p in points if p["ts"] >= cutoff]
+        return {"name": name, "kind": self._kinds.get(name, ""),
+                "tier": tier,
+                "interval_s": (self.raw_interval_s if tier == "raw"
+                               else self.rollup_interval_s),
+                "points": points}
+
+
+def build_timeline(records: List[dict], spans: Optional[List[dict]] = None,
+                   start_ts: Optional[float] = None,
+                   end_ts: Optional[float] = None) -> dict:
+    """Render merged task-event records (+ optional span records) as a
+    Perfetto-loadable chrome-trace JSON object.
+
+    Tracks: one synthetic pid per node, one tid per worker (named via
+    ``ph:"M"`` metadata). Each task renders as a ``pending:`` slice
+    (SUBMITTED→RUNNING — scheduling latency is visible, not hidden) and an
+    execution slice (RUNNING→terminal); parent→child task edges join on
+    the span linkage the task events carry (``span_id``/``parent_span``)
+    and render as the PR 3 flow arrows (``ph:"s"/"f"`` pairs). Span
+    records (``tracing.profile()`` blocks, submit anchors) are appended
+    through :func:`tracing.spans_to_chrome_events` so the built-in
+    hot-path spans appear in the same trace."""
+    events: List[dict] = []
+    pids: Dict[str, int] = {}
+    tids: Dict[Tuple[int, str], int] = {}
+
+    def _pid(node: str) -> int:
+        if node not in pids:
+            pids[node] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pids[node], "tid": 0,
+                           "args": {"name": f"node:{node[:12] or '?'}"}})
+        return pids[node]
+
+    def _tid(pid: int, worker: str) -> int:
+        key = (pid, worker)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": tids[key],
+                           "args": {"name": f"worker:{worker or '?'}"}})
+        return tids[key]
+
+    slices: Dict[str, Tuple[int, int, float, float]] = {}
+    kept: List[dict] = []
+    for rec in records:
+        evs = rec.get("events") or []
+        if not evs:
+            continue
+        t0, t1 = rec.get("start_ts", evs[0]["ts"]), rec.get(
+            "end_ts", evs[-1]["ts"])
+        if start_ts is not None and t1 < start_ts:
+            continue
+        if end_ts is not None and t0 > end_ts:
+            continue
+        kept.append(rec)
+        pid = _pid(rec.get("node", ""))
+        tid = _tid(pid, rec.get("worker", ""))
+        name = rec.get("name") or rec["task_id"][:12]
+        run_ts = next((e["ts"] for e in evs if e["state"] == RUNNING), None)
+        if run_ts is not None and run_ts > t0:
+            events.append({
+                "name": f"pending:{name}", "cat": "pending", "ph": "X",
+                "ts": t0 * 1e6, "dur": (run_ts - t0) * 1e6,
+                "pid": pid, "tid": tid,
+                "args": {"task_id": rec["task_id"]}})
+        exec_start = run_ts if run_ts is not None else t0
+        events.append({
+            "name": name, "cat": "task", "ph": "X",
+            "ts": exec_start * 1e6,
+            "dur": max(t1 - exec_start, 0.0) * 1e6,
+            "pid": pid, "tid": tid,
+            "args": {"task_id": rec["task_id"], "state": rec.get("state"),
+                     "attempt": rec.get("attempt", 0),
+                     "job_id": rec.get("job_id", "")}})
+        if rec.get("span_id"):
+            slices[rec["span_id"]] = (pid, tid, exec_start,
+                                      max(t1 - exec_start, 0.0))
+    flow_n = 0
+    for rec in kept:
+        parent = slices.get(rec.get("parent_span") or "")
+        child = slices.get(rec.get("span_id") or "")
+        if parent is None or child is None or parent is child:
+            continue
+        flow_n += 1
+        ppid, ptid, pts, pdur = parent
+        cpid, ctid, cts, _ = child
+        # bind the arrow start inside the parent slice
+        anchor = min(max(cts, pts), pts + pdur)
+        events.append({"name": "task_flow", "cat": "flow", "ph": "s",
+                       "id": flow_n, "ts": anchor * 1e6,
+                       "pid": ppid, "tid": ptid})
+        events.append({"name": "task_flow", "cat": "flow", "ph": "f",
+                       "bp": "e", "id": flow_n, "ts": cts * 1e6,
+                       "pid": cpid, "tid": ctid})
+    if spans:
+        from ray_tpu.util.tracing import spans_to_chrome_events
+
+        window = [s for s in spans
+                  if (start_ts is None or s["ts"] + max(s.get("dur", 0.0), 0.0)
+                      >= start_ts)
+                  and (end_ts is None or s["ts"] <= end_ts)]
+        # span flow ids live in their own range so they never collide with
+        # the task-record arrows above
+        events.extend(spans_to_chrome_events(window,
+                                             flow_id_base=flow_n + 1_000_000))
+    return {"traceEvents": events}
 
 
 class GcsServer:
@@ -452,6 +822,12 @@ class GcsServer:
         # task lifecycle events, sharded + pipelined (reference:
         # gcs_task_manager.cc; the sharding is ours — see ShardedTaskEvents)
         self.task_manager = ShardedTaskEvents()
+        # cluster health plane: metrics time-series history + the
+        # stuck/straggler scanner's latest report
+        self.metrics_history = MetricsHistory()
+        self._health: dict = {"ts": 0.0, "status": "unknown",
+                              "findings": [], "scan_count": 0}
+        self._health_warn_ts: Dict[tuple, float] = {}
         self._background: List[asyncio.Task] = []
         self.start_time = time.time()
         self._load_init_data()
@@ -533,10 +909,12 @@ class GcsServer:
         addr = await self.server.start()
         self._background.append(spawn(self._health_check_loop(),
                                       what="gcs health-check loop"))
-        for i in range(len(self.task_manager.shards)):
-            self._background.append(spawn(
-                self.task_manager.drain_loop(i),
-                what=f"task-event drain shard {i}"))
+        # merge thread for task-event ingest + read handoff (off-loop)
+        self.task_manager._ensure_thread()
+        self._background.append(spawn(self._metrics_history_loop(),
+                                      what="gcs metrics-history sampler"))
+        self._background.append(spawn(self._health_monitor_loop(),
+                                      what="gcs health-monitor scanner"))
         # resume interrupted scheduling work from replayed init data
         for record in self.actors.values():
             if record.state in ("PENDING_CREATION", "RESTARTING"):
@@ -560,6 +938,7 @@ class GcsServer:
     async def stop(self):
         for t in self._background:
             t.cancel()
+        self.task_manager.stop()
         await self.server.stop()
         self.store.close()
 
@@ -711,7 +1090,18 @@ class GcsServer:
             return {"added": False}
         self.kv[key] = req["value"]
         self._persist_kv(key[0], key[1], req["value"])
+        self._observe_kv(key[0], key[1], req["value"])
         return {"added": True}
+
+    def _observe_kv(self, ns: str, key: str, value):
+        """Tap metric-snapshot puts into the history ring (the reporters
+        keep their single KV write; history costs them nothing)."""
+        if ns != "metrics":
+            return
+        try:
+            self.metrics_history.observe_payload(key, wire.loads(value))
+        except Exception as e:
+            logger.debug("undecodable metrics payload %s: %s", key, e)
 
     async def _rpc_KVGet(self, req, conn):
         return {"value": self.kv.get((req.get("ns", ""), req["key"]))}
@@ -725,6 +1115,7 @@ class GcsServer:
             key = (item.get("ns", ""), item["key"])
             self.kv[key] = item["value"]
             self._persist_kv(key[0], key[1], item["value"])
+            self._observe_kv(key[0], key[1], item["value"])
             added += 1
         return {"added": added}
 
@@ -842,20 +1233,51 @@ class GcsServer:
         return {"status": "ok"}
 
     async def _rpc_ListTasks(self, req, conn):
-        self.task_manager.flush_sync()  # reads see everything enqueued
-        return {"tasks": self.task_manager.list_tasks(
-            job_id=req.get("job_id"), name=req.get("name"),
-            state=req.get("state"), limit=int(req.get("limit") or 200))}
+        # read handoff: the merge thread runs the query after everything
+        # already enqueued has merged — the GCS loop never pays the merge
+        job_id, name = req.get("job_id"), req.get("name")
+        state, limit = req.get("state"), int(req.get("limit") or 200)
+        return {"tasks": await self.task_manager.read(
+            lambda tm: tm.list_tasks(job_id=job_id, name=name, state=state,
+                                     limit=limit))}
 
     async def _rpc_GetTask(self, req, conn):
-        # only the one shard this task hashes to needs to be current
-        tm = self.task_manager
-        tm.flush_shard(tm._shard_of(req["task_id"]))
-        return {"task": tm.get_task(req["task_id"])}
+        tid = req["task_id"]
+        return {"task": await self.task_manager.read(
+            lambda tm: tm.get_task(tid))}
 
     async def _rpc_SummarizeTasks(self, req, conn):
-        self.task_manager.flush_sync()
-        return self.task_manager.summarize(job_id=req.get("job_id"))
+        job_id = req.get("job_id")
+        return await self.task_manager.read(
+            lambda tm: tm.summarize(job_id=job_id))
+
+    async def _rpc_GetTimeline(self, req, conn):
+        """Chrome-trace (Perfetto) JSON of the task flow graph, filterable
+        by job and time window; span records from the trace table ride
+        along so built-in hot-path spans land in the same trace. Built on
+        the merge thread — a timeline scrape never stalls ingest."""
+        job_id = req.get("job_id")
+        start_ts, end_ts = req.get("start_ts"), req.get("end_ts")
+        limit = int(req.get("limit") or 5000)
+        blobs: List[bytes] = []
+        if req.get("spans", True):
+            # snapshot the blob list on the loop (self.kv belongs to it);
+            # decode off-loop on the merge thread
+            blobs = [v for (ns, k), v in self.kv.items()
+                     if ns == "trace" and k.startswith("spans_") and v]
+
+        def _build(tm):
+            spans: List[dict] = []
+            for blob in blobs:
+                try:
+                    spans.extend(wire.loads(blob))
+                except Exception as e:
+                    logger.debug("undecodable span blob skipped: %s", e)
+            records = tm.list_tasks(job_id=job_id, limit=limit)
+            return build_timeline(records, spans,
+                                  start_ts=start_ts, end_ts=end_ts)
+
+        return await self.task_manager.read(_build)
 
     async def _rpc_Subscribe(self, req, conn):
         channels = set(req["channels"])
@@ -1613,6 +2035,180 @@ class GcsServer:
             and pg.spec.strategy == "STRICT_SPREAD"
         ]
         return {"nodes": nodes, "demands": demands, "strict_spread": strict_spread}
+
+    # ------------------------------------------------------------------
+    # cluster health plane: metrics history + stuck/straggler monitor
+    # ------------------------------------------------------------------
+
+    async def _metrics_history_loop(self):
+        """Sample the aggregated metric snapshots into the raw history
+        ring every ``metrics_history_interval_s`` (the rollup tier fires
+        from inside :meth:`MetricsHistory.sample`)."""
+        interval = RAY_CONFIG.metrics_history_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.metrics_history.sample()
+            except Exception:
+                logger.exception("metrics-history sample failed")
+
+    async def _rpc_GetMetricsHistory(self, req, conn):
+        name = req.get("name")
+        if not name:
+            return {"names": self.metrics_history.names()}
+        return {"history": self.metrics_history.series(
+            name, window_s=req.get("window_s"),
+            tier=req.get("tier") or "auto")}
+
+    async def _health_monitor_loop(self):
+        interval = RAY_CONFIG.health_scan_interval_s
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self._health_scan()
+            except Exception:
+                logger.exception("cluster health scan failed")
+
+    async def _health_scan(self) -> dict:
+        """One pass of the cluster health monitor: stuck tasks (RUNNING far
+        past the per-function p99 of completed runs), straggler raylets
+        (lease-queue / event-loop-lag outliers vs the cluster median, and
+        lagging heartbeats), and provisioning-pool pathology (dead zygote,
+        starved warm pool). The task scan runs on the task-event merge
+        thread; findings surface via ``GetClusterHealth`` → ``/api/health``
+        / ``util.state.cluster_health`` / ``ray-tpu health``, plus
+        rate-limited warning logs."""
+        now = time.time()
+        cfg = RAY_CONFIG
+        findings: List[dict] = []
+
+        # -- stuck tasks ------------------------------------------------
+        stuck_min = cfg.health_stuck_min_s
+        stuck_factor = cfg.health_stuck_p99_factor
+        stuck_fallback = cfg.health_stuck_fallback_s
+
+        def _scan_stuck(tm) -> List[dict]:
+            durations: Dict[str, List[float]] = {}
+            running: List[Tuple[dict, float]] = []
+            for rec in tm.list_tasks(limit=100_000):
+                run_ts = next((e["ts"] for e in rec["events"]
+                               if e["state"] == RUNNING), None)
+                if run_ts is None:
+                    continue
+                if rec["state"] == "FINISHED":
+                    durations.setdefault(rec["name"] or "?", []).append(
+                        rec["end_ts"] - run_ts)
+                elif rec["state"] == RUNNING:
+                    running.append((rec, run_ts))
+            out = []
+            for rec, run_ts in running:
+                fn = rec["name"] or "?"
+                age = now - run_ts
+                ds = sorted(durations.get(fn, ()))
+                if ds:
+                    p99 = ds[min(len(ds) - 1, int(0.99 * len(ds)))]
+                    threshold = max(stuck_min, stuck_factor * p99)
+                else:
+                    p99 = None  # no completed sample yet: conservative
+                    threshold = max(stuck_min, stuck_fallback)
+                if age > threshold:
+                    out.append({
+                        "kind": "stuck_task", "severity": "warning",
+                        "task_id": rec["task_id"], "name": fn,
+                        "node": rec.get("node", ""),
+                        "worker": rec.get("worker", ""),
+                        "age_s": age, "threshold_s": threshold,
+                        "p99_s": p99})
+            return out
+
+        findings.extend(await self.task_manager.read(_scan_stuck))
+
+        # -- straggler raylets ------------------------------------------
+        for metric, floor in (("ray_tpu_raylet_lease_queue_depth", 4.0),
+                              ("ray_tpu_raylet_loop_lag_seconds", 0.2)):
+            by_node = self.metrics_history.latest_by_node(metric)
+            if len(by_node) < 2:
+                continue
+            vals = sorted(by_node.values())
+            median = vals[len(vals) // 2]
+            for node, v in by_node.items():
+                if v > floor and v > cfg.health_straggler_factor * max(
+                        median, 1e-9):
+                    findings.append({
+                        "kind": "straggler_node", "severity": "warning",
+                        "node": node, "metric": metric, "value": v,
+                        "cluster_median": median})
+        timeout = RAY_CONFIG.health_check_timeout_ms / 1000.0
+        mono = time.monotonic()
+        for node_id, info in self.nodes.items():
+            if not info.alive:
+                continue
+            lag = mono - self.node_last_seen.get(node_id, mono)
+            if lag > timeout / 2:  # lagging but not yet declared dead
+                findings.append({
+                    "kind": "straggler_node", "severity": "warning",
+                    "node": node_id.hex()[:16], "metric": "heartbeat_lag_s",
+                    "value": lag, "cluster_median": 0.0})
+
+        # -- provisioning pools -----------------------------------------
+        for (ns, key), blob in list(self.kv.items()):
+            if ns != "workers" or not blob:
+                continue
+            try:
+                entry = wire.loads(blob)
+            except Exception as e:
+                logger.debug("undecodable workers entry %s: %s", key, e)
+                continue
+            pool = entry.get("pool") or {}
+            node = str(entry.get("node", key))[:16]
+            if pool.get("enabled") and not pool.get("zygote_alive"):
+                findings.append({
+                    "kind": "dead_zygote", "severity": "error",
+                    "node": node,
+                    "zygote_restarts": pool.get("zygote_restarts", 0)})
+            elif (pool.get("warm_target", 0) > 0
+                    and pool.get("warm_default_env", 0) == 0):
+                findings.append({
+                    "kind": "pool_starvation", "severity": "warning",
+                    "node": node,
+                    "warm_target": pool.get("warm_target", 0),
+                    "misses": pool.get("misses", 0)})
+
+        status = "ok"
+        if any(f["severity"] == "error" for f in findings):
+            status = "error"
+        elif findings:
+            status = "warning"
+        self._health = {
+            "ts": now, "status": status, "findings": findings,
+            "scan_count": self._health.get("scan_count", 0) + 1,
+            "scan_interval_s": cfg.health_scan_interval_s,
+            "nodes_alive": sum(1 for n in self.nodes.values() if n.alive),
+        }
+        # rate-limited warning logs + structured events (one per finding
+        # identity per health_warn_interval_s, not one per scan)
+        for f in findings:
+            ident = (f["kind"], f.get("node", ""), f.get("task_id", ""))
+            if now - self._health_warn_ts.get(ident, 0.0) \
+                    < cfg.health_warn_interval_s:
+                continue
+            self._health_warn_ts[ident] = now
+            detail = {k: v for k, v in f.items()
+                      if k not in ("kind", "severity")}
+            logger.warning("cluster health: %s %s", f["kind"], detail)
+            self._record_event("health", f["severity"].upper(),
+                               f"health finding: {f['kind']}", **detail)
+        if len(self._health_warn_ts) > 10_000:  # bounded dedup memory
+            cutoff = now - cfg.health_warn_interval_s
+            self._health_warn_ts = {k: ts for k, ts
+                                    in self._health_warn_ts.items()
+                                    if ts >= cutoff}
+        return self._health
+
+    async def _rpc_GetClusterHealth(self, req, conn):
+        if req.get("scan") or not self._health.get("scan_count"):
+            await self._health_scan()
+        return {"health": self._health}
 
     # ------------------------------------------------------------------
     # debug / state api

@@ -93,8 +93,12 @@ def _child_main(control_fd: int, args: dict, zygote_pid: int) -> "None":
         random.seed()
         if "numpy" in sys.modules:
             sys.modules["numpy"].random.seed()
-        from ray_tpu._private.worker_main import run_worker
+        from ray_tpu._private.worker_main import (
+            reset_observability_after_fork, run_worker)
 
+        # the zygote image holds live span/task-event buffers and a metric
+        # registry; the child must not re-emit them as its own
+        reset_observability_after_fork()
         run_worker(
             args["raylet_address"], args["gcs_address"], args["node_id"],
             log_dir=args.get("log_dir", ""),

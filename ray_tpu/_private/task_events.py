@@ -66,13 +66,17 @@ def set_enabled(value: Optional[bool]):
 
 def record(task_id_hex: str, state: str, *, name: str = "", job_id: str = "",
            attempt: int = 0, error: str = "", worker: str = "",
-           node: str = "", arg_bytes: int = 0, ret_bytes: int = 0) -> None:
+           node: str = "", arg_bytes: int = 0, ret_bytes: int = 0,
+           span_id: str = "", parent_span: str = "") -> None:
     """Buffer one state transition. Cheap (lock + append); never raises.
 
     ``arg_bytes`` rides the owner's SUBMITTED event (serialized argument
     payload size), ``ret_bytes`` the terminal FINISHED event (serialized
     return payload size, inline or store-resident) — the per-task object
-    accounting surfaced by ``summarize_tasks``."""
+    accounting surfaced by ``summarize_tasks``. ``span_id`` is the task's
+    deterministic execution-span id and ``parent_span`` the submitter's
+    active span: the GCS timeline endpoint joins them across task records
+    to draw parent→child flow arrows without needing the span table."""
     if not enabled():
         return
     event: Dict[str, Any] = {"task_id": task_id_hex, "state": state,
@@ -81,6 +85,10 @@ def record(task_id_hex: str, state: str, *, name: str = "", job_id: str = "",
         event["name"] = name
     if job_id:
         event["job_id"] = job_id
+    if span_id:
+        event["span_id"] = span_id
+    if parent_span:
+        event["parent_span"] = parent_span
     if arg_bytes:
         event["arg_bytes"] = int(arg_bytes)
     if ret_bytes:
@@ -130,6 +138,18 @@ def rebuffer(events: List[dict], dropped: int = 0):
 def pending() -> int:
     with _lock:
         return len(_buffer)
+
+
+def reset_after_fork():
+    """Drop the buffer a forked child inherited from its parent's image.
+    Without this a zygote-forked worker re-ships the zygote process's
+    buffered transitions (and their drop counter) to the GCS on its first
+    flush, duplicating records the parent already owns."""
+    global _dropped, _enabled
+    with _lock:
+        _buffer.clear()
+        _dropped = 0
+    _enabled = None  # re-read the env in the child (runtime env may differ)
 
 
 def flush():

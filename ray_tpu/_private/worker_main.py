@@ -25,6 +25,23 @@ import time
 from typing import Optional
 
 
+def reset_observability_after_fork() -> None:
+    """Reset every inherited observability buffer in a forked worker.
+
+    The zygote image carries live span buffers, task-event buffers and a
+    metrics registry; a forked child that keeps them re-emits the parent
+    process's buffered events/spans under its own identity and re-reports
+    the parent's accumulated counters (the ``_obs_proc_tag`` class of
+    fork bug, PR 8). Called by the zygote's fork child before
+    :func:`run_worker`; safe to call in any process."""
+    from ray_tpu._private import task_events
+    from ray_tpu.util import metrics, tracing
+
+    task_events.reset_after_fork()
+    tracing.reset_after_fork()
+    metrics.reset_after_fork()
+
+
 def run_worker(raylet_address: str, gcs_address: str, node_id_hex: str,
                log_dir: str = "", runtime_env: Optional[dict] = None,
                orphan_ppid: Optional[int] = None) -> None:

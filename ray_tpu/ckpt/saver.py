@@ -113,7 +113,10 @@ def _write_snapshot(store: CheckpointStore, ckpt_id: str, step: int,
     """Background half: serialize/hash/write chunks, commit the manifest."""
     import numpy as np
 
+    from ray_tpu.util import tracing
+
     t0 = time.monotonic()
+    ser_start = time.time()
     spec_payload = None
     boxes_of = None
     if spec is not None:
@@ -155,6 +158,10 @@ def _write_snapshot(store: CheckpointStore, ckpt_id: str, step: int,
             else:
                 reused += 1
                 reused_b += n
+    # explicit record (not profile()): an exception mid-serialize must not
+    # leave a suspended span generator behind on this background thread
+    tracing.record_span("ckpt.serialize", ser_start, time.time(),
+                        category="ckpt", ckpt_id=ckpt_id, step=step)
     total_b = written_b + reused_b
     write_s = time.monotonic() - t0
     manifest = mf.Manifest(
@@ -166,9 +173,10 @@ def _write_snapshot(store: CheckpointStore, ckpt_id: str, step: int,
                "chunks_reused": reused,
                "dedup_ratio": (reused_b / total_b) if total_b else 0.0,
                "pause_s": pause_s, "write_s": write_s})
-    store.commit(manifest)
-    if keep_last is not None:
-        store.retention(keep_last)
+    with tracing.profile("ckpt.commit", category="ckpt", ckpt_id=ckpt_id):
+        store.commit(manifest)
+        if keep_last is not None:
+            store.retention(keep_last)
     obs = _obs()
     obs["commit"].observe(write_s)
     obs["bytes_written"].inc(written_b)
@@ -199,10 +207,14 @@ class CheckpointSaver:
         ``store.wait_for``). ``spec`` (a ``ShardedTreeSpec``) records the
         shard geometry and splits leaves into per-box chunks; without it
         the tree is saved as one full-extent chunk per leaf."""
+        from ray_tpu.util import tracing
+
         with self._lock:
             self._drain_locked()  # backpressure + surface prior errors
             t0 = time.monotonic()
-            skeleton, snap = snapshot_tree(tree)
+            with tracing.profile("ckpt.snapshot", category="ckpt",
+                                 step=step):
+                skeleton, snap = snapshot_tree(tree)
             pause_s = time.monotonic() - t0
             _obs()["pause"].observe(pause_s)
             ckpt_id = mf.new_ckpt_id(step)

@@ -14,6 +14,9 @@ Endpoints:
   GET  /api/cluster_status   - autoscaler view (demands, idle, per-node)
   GET  /api/summary          - aggregate counts
   GET  /api/workers          - per-node worker-pool / provisioning stats
+  GET  /api/timeline         - Perfetto chrome-trace of the task flow graph
+  GET  /api/health           - cluster-health report (stuck/straggler scan)
+  GET  /api/metrics/history  - metric time-series (raw + rollup tiers)
   GET  /metrics              - Prometheus text exposition
   GET  /api/jobs             - submitted jobs (job manager KV)
   POST /api/jobs             - {"entrypoint": ..., "runtime_env": ...}
@@ -88,6 +91,9 @@ class DashboardHead:
             web.get("/api/summary", self._summary),
             web.get("/api/tasks", self._tasks),
             web.get("/api/tasks/summary", self._tasks_summary),
+            web.get("/api/timeline", self._timeline),
+            web.get("/api/health", self._health),
+            web.get("/api/metrics/history", self._metrics_history),
             web.get("/api/workers", self._workers),
             web.get("/metrics", self._prometheus),
             web.get("/api/nodes/{node_id}/stats", self._node_stats),
@@ -270,6 +276,51 @@ class DashboardHead:
 
         return web.json_response(await self._call(
             "SummarizeTasks", {"job_id": request.query.get("job_id")}))
+
+    async def _timeline(self, request):
+        """Perfetto-loadable chrome-trace JSON of the task flow graph from
+        the GCS task-event ring (+ built-in spans), filterable by job and
+        time window. Query params: job_id, start_ts, end_ts (unix
+        seconds), limit, spans=0 to omit span records. Save the body and
+        open it in ui.perfetto.dev / chrome://tracing."""
+        from aiohttp import web
+
+        q = request.query
+        req = {"job_id": q.get("job_id") or None,
+               "limit": int(q.get("limit", 5000)),
+               "spans": q.get("spans", "1") not in ("0", "false")}
+        if q.get("start_ts"):
+            req["start_ts"] = float(q["start_ts"])
+        if q.get("end_ts"):
+            req["end_ts"] = float(q["end_ts"])
+        return web.json_response(await self._call("GetTimeline", req))
+
+    async def _health(self, request):
+        """Latest cluster-health report (stuck tasks, straggler nodes,
+        provisioning-pool pathology). ``?scan=1`` forces a scan NOW
+        instead of returning the last periodic one."""
+        from aiohttp import web
+
+        scan = request.query.get("scan", "0") not in ("0", "false", "")
+        reply = await self._call("GetClusterHealth", {"scan": scan})
+        return web.json_response(reply["health"])
+
+    async def _metrics_history(self, request):
+        """Metric time-series from the GCS history ring. Query params:
+        name (omit to list recorded names), window (seconds),
+        tier=raw|rollup|auto."""
+        from aiohttp import web
+
+        q = request.query
+        name = q.get("name")
+        if not name:
+            return web.json_response(
+                (await self._call("GetMetricsHistory", {}))["names"])
+        req = {"name": name, "tier": q.get("tier") or "auto"}
+        if q.get("window"):
+            req["window_s"] = float(q["window"])
+        reply = await self._call("GetMetricsHistory", req)
+        return web.json_response(reply["history"])
 
     async def _pgs(self, request):
         from aiohttp import web

@@ -10,6 +10,8 @@ definition.
 from __future__ import annotations
 
 import functools
+import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -17,6 +19,36 @@ import numpy as np
 from ray_tpu.models.transformer import Transformer, TransformerConfig, lm_loss
 from ray_tpu.parallel.mesh import LOGICAL_RULES, logical_to_mesh_sharding
 from ray_tpu.utils import import_jax
+
+_metrics_lock = threading.Lock()
+_metrics: Optional[dict] = None
+
+
+def _obs() -> dict:
+    """Lazily-created train-step metrics on the shared registry (always
+    on: every step through TrainStepBundle lands in ``/metrics``)."""
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Histogram
+
+            bounds = [0.001, 0.01, 0.1, 1, 10]
+            _metrics = {
+                "step": Histogram(
+                    "ray_tpu.train.step_seconds",
+                    "full train step wall time (fwd+bwd+optimizer; "
+                    "device-synchronized when tracing is enabled)",
+                    boundaries=bounds),
+                "fwd_bwd": Histogram(
+                    "ray_tpu.train.fwd_bwd_seconds",
+                    "forward+backward (value_and_grad) phase of the "
+                    "traced train step", boundaries=bounds),
+                "optimizer": Histogram(
+                    "ray_tpu.train.optimizer_seconds",
+                    "optimizer update+apply phase of the traced train "
+                    "step", boundaries=bounds),
+            }
+        return _metrics
 
 
 def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
@@ -79,15 +111,48 @@ class TrainStepBundle:
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
+        batch_shardings = {"tokens": self.batch_sharding,
+                           "targets": self.batch_sharding,
+                           "mask": self.batch_sharding}
         donate_args = (0, 1) if donate else ()
-        self.step = jax.jit(
+        self._fused_step = jax.jit(
             train_step,
             in_shardings=(self.param_shardings, self.opt_shardings,
-                          {"tokens": self.batch_sharding,
-                           "targets": self.batch_sharding,
-                           "mask": self.batch_sharding}),
+                          batch_shardings),
             out_shardings=(self.param_shardings, self.opt_shardings, self.repl),
             donate_argnums=donate_args,
+        )
+
+        # phase-split programs for the TRACED step (fwd+bwd and optimizer
+        # as separate XLA programs, so tracing.profile() spans can bound
+        # each phase); the untraced path keeps the fused program — and its
+        # fusion/donation — untouched
+        def fwd_bwd(params, batch):
+            return jax.value_and_grad(loss_fn)(
+                params, batch["tokens"], batch["targets"], batch.get("mask"))
+
+        self._fwd_bwd = jax.jit(
+            fwd_bwd,
+            in_shardings=(self.param_shardings, batch_shardings),
+            out_shardings=(self.repl, self.param_shardings),
+        )
+
+        def opt_apply(grads, opt_state, params):
+            import optax
+
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._opt_apply = jax.jit(
+            opt_apply,
+            in_shardings=(self.param_shardings, self.opt_shardings,
+                          self.param_shardings),
+            out_shardings=(self.param_shardings, self.opt_shardings),
+            # donate opt_state + params (consumed, re-emitted); grads stay
+            # undonated — XLA can't alias them onto the outputs here and
+            # would warn on every traced step
+            donate_argnums=(1, 2) if donate else (),
         )
 
         def eval_step(params, batch):
@@ -96,6 +161,37 @@ class TrainStepBundle:
             return lm_loss(logits, batch["targets"], batch.get("mask"))
 
         self.eval_step = jax.jit(eval_step)
+
+    def step(self, params, opt_state, batch):
+        """One optimization step, instrumented (built-in spans + the
+        ``ray_tpu.train.*`` histograms — no manual instrumentation in the
+        train loop). With tracing OFF this dispatches the single fused XLA
+        program, identical to the uninstrumented path; with tracing ON the
+        step runs as separately-jitted fwd/bwd and optimizer programs with
+        a ``train.step`` span tree bounding each phase, so Perfetto shows
+        where the step time goes."""
+        from ray_tpu.util import tracing
+
+        t0 = time.perf_counter()
+        if not tracing.enabled():
+            out = self._fused_step(params, opt_state, batch)
+            _obs()["step"].observe(time.perf_counter() - t0)
+            return out
+        jax = import_jax()
+        obs = _obs()
+        with tracing.profile("train.step", category="train"):
+            with tracing.profile("train.fwd_bwd", category="train"):
+                t1 = time.perf_counter()
+                loss, grads = self._fwd_bwd(params, batch)
+                jax.block_until_ready(grads)
+                obs["fwd_bwd"].observe(time.perf_counter() - t1)
+            with tracing.profile("train.optimizer", category="train"):
+                t2 = time.perf_counter()
+                params, opt_state = self._opt_apply(grads, opt_state, params)
+                jax.block_until_ready(params)
+                obs["optimizer"].observe(time.perf_counter() - t2)
+        obs["step"].observe(time.perf_counter() - t0)
+        return params, opt_state, loss
 
     def make_batch(self, rng: np.random.Generator, batch_size: int, seq_len: int):
         """Synthetic LM batch (tokens/targets/mask) laid out for the mesh."""

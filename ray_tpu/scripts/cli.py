@@ -119,12 +119,48 @@ def cmd_job(args):
 
 def cmd_timeline(args):
     """ray-tpu timeline: export a chrome://tracing JSON of task spans
-    (reference: `ray timeline`)."""
+    (reference: `ray timeline`). ``--from-gcs`` renders the task flow
+    graph from the GCS task-event ring instead (works without tracing
+    enabled; same payload as ``GET /api/timeline``)."""
     _connect(args)
-    from ray_tpu.util import tracing
 
-    n = tracing.export_chrome_trace(args.out)
+    if args.from_gcs:
+        from ray_tpu.util.state import get_timeline
+
+        trace = get_timeline(job_id=args.job_id or None)
+        with open(args.out, "w") as f:
+            json.dump(trace, f)
+        n = len(trace["traceEvents"])
+    else:
+        from ray_tpu.util import tracing
+
+        n = tracing.export_chrome_trace(args.out)
     print(f"wrote {n} events to {args.out} (open in chrome://tracing)")
+
+
+def cmd_health(args):
+    """ray-tpu health: the GCS cluster-health report (stuck tasks,
+    straggler nodes, dead-zygote/pool starvation)."""
+    _connect(args)
+    import time as _t
+
+    from ray_tpu.util.state import cluster_health
+
+    health = cluster_health(scan=args.scan)
+    if args.json:
+        print(json.dumps(health, indent=2, default=str))
+        return
+    ts = _t.strftime("%H:%M:%S", _t.localtime(health.get("ts", 0)))
+    print(f"[{health.get('status', 'unknown').upper()}] scanned {ts} "
+          f"(scan #{health.get('scan_count', 0)}, every "
+          f"{health.get('scan_interval_s', 0):g}s, "
+          f"{health.get('nodes_alive', 0)} nodes alive)")
+    for f in health.get("findings", []):
+        detail = " ".join(f"{k}={v}" for k, v in f.items()
+                          if k not in ("kind", "severity"))
+        print(f"  {f['severity']:7} {f['kind']}: {detail}")
+    if not health.get("findings"):
+        print("  no findings")
 
 
 def cmd_events(args):
@@ -310,7 +346,18 @@ def main(argv=None):
 
     p = sub.add_parser("timeline", help="export chrome://tracing task timeline")
     p.add_argument("--out", default="timeline.json")
+    p.add_argument("--from-gcs", action="store_true",
+                   help="render from the GCS task-event ring (no tracing "
+                        "needed) instead of the span table")
+    p.add_argument("--job-id", default="", help="filter by job (with --from-gcs)")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("health", help="cluster-health report "
+                                      "(stuck/straggler/pool findings)")
+    p.add_argument("--scan", action="store_true",
+                   help="force a scan now instead of the last periodic one")
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_health)
 
     p = sub.add_parser("events", help="recent structured cluster events")
     p.add_argument("--source", default="")

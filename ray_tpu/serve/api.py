@@ -16,6 +16,7 @@ import asyncio
 import copy
 import functools
 import random
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -27,6 +28,39 @@ import ray_tpu
 from ray_tpu.exceptions import TaskError
 
 CONTROLLER_NAME = "serve_controller"
+
+_obs_lock = threading.Lock()
+_obs_metrics: Optional[dict] = None
+
+
+def _obs() -> dict:
+    """Lazily-created serve request metrics on the shared registry
+    (always on: every request through a handle/replica lands in
+    ``/metrics`` with route/queue/execute phase histograms)."""
+    global _obs_metrics
+    with _obs_lock:
+        if _obs_metrics is None:
+            from ray_tpu.util.metrics import Counter, Histogram
+
+            bounds = [0.001, 0.01, 0.1, 1, 10]
+            _obs_metrics = {
+                "route": Histogram(
+                    "ray_tpu.serve.route_seconds",
+                    "handle-side routing: topology refresh + replica pick",
+                    boundaries=bounds),
+                "queue": Histogram(
+                    "ray_tpu.serve.queue_seconds",
+                    "request wait between handle dispatch and replica "
+                    "execution start", boundaries=bounds),
+                "execute": Histogram(
+                    "ray_tpu.serve.execute_seconds",
+                    "user-callable execution on the replica",
+                    boundaries=bounds),
+                "requests": Counter(
+                    "ray_tpu.serve.requests",
+                    "requests executed by this replica process"),
+            }
+        return _obs_metrics
 
 
 # ---------------------------------------------------------------------------
@@ -160,12 +194,22 @@ class _Replica:
 
         from ray_tpu._private.serialization import loads_trusted
         from ray_tpu.serve.multiplex import _set_current_model_id
+        from ray_tpu.util import tracing
 
         args, kwargs = loads_trusted(args_blob)
         model_id = kwargs.pop("_serve_multiplexed_model_id", "")
+        submit_ts = kwargs.pop("_serve_submit_ts", None)
+        now = time.time()
+        if submit_ts is not None and now >= submit_ts:
+            # handle-dispatch → execution-start wait (the actor queue):
+            # built-in queue phase of every serve request
+            _obs()["queue"].observe(now - submit_ts)
+            tracing.record_span("serve.queue", submit_ts, now,
+                                category="serve")
         token = _set_current_model_id(model_id)
         self._num_ongoing += 1
         self._peak_ongoing = max(self._peak_ongoing, self._num_ongoing)
+        t_exec = time.perf_counter()
         try:
             if method_name == "__call__":
                 if not callable(self._callable):
@@ -173,19 +217,24 @@ class _Replica:
                 fn = self._callable
             else:
                 fn = getattr(self._callable, method_name)
-            if asyncio.iscoroutinefunction(fn):
-                out = await fn(*args, **kwargs)
-            else:
-                # sync user code runs off-loop so it can call other handles;
-                # copy the context so get_multiplexed_model_id() works there
-                loop = asyncio.get_event_loop()
-                ctx = _cv.copy_context()
-                out = await loop.run_in_executor(
-                    None, functools.partial(ctx.run, fn, *args, **kwargs))
-                if asyncio.iscoroutine(out):
-                    out = await out
+            with tracing.profile("serve.execute", category="serve"):
+                if asyncio.iscoroutinefunction(fn):
+                    out = await fn(*args, **kwargs)
+                else:
+                    # sync user code runs off-loop so it can call other
+                    # handles; copy the context so
+                    # get_multiplexed_model_id() works there
+                    loop = asyncio.get_event_loop()
+                    ctx = _cv.copy_context()
+                    out = await loop.run_in_executor(
+                        None, functools.partial(ctx.run, fn, *args, **kwargs))
+                    if asyncio.iscoroutine(out):
+                        out = await out
             return out
         finally:
+            obs = _obs()
+            obs["execute"].observe(time.perf_counter() - t_exec)
+            obs["requests"].inc()
             self._num_ongoing -= 1
 
     async def handle_request_streaming(self, method_name: str,
@@ -201,6 +250,14 @@ class _Replica:
 
         args, kwargs = loads_trusted(args_blob)
         kwargs.pop("_serve_multiplexed_model_id", "")
+        submit_ts = kwargs.pop("_serve_submit_ts", None)
+        now = time.time()
+        if submit_ts is not None and now >= submit_ts:
+            from ray_tpu.util import tracing
+
+            _obs()["queue"].observe(now - submit_ts)
+            tracing.record_span("serve.queue", submit_ts, now,
+                                category="serve")
         if method_name == "__call__":
             fn = self._callable
         else:
@@ -685,7 +742,13 @@ class DeploymentHandle:
             # serve/multiplex.py + prefix-aware routing)
             kwargs["_serve_multiplexed_model_id"] = self._model_id
             return self.remote_with_key(self._model_id, *args, **kwargs)
-        replica = self._pick()
+        from ray_tpu.util import tracing
+
+        t0 = time.perf_counter()
+        with tracing.profile("serve.route", category="serve",
+                             deployment=self._name):
+            replica = self._pick()
+        _obs()["route"].observe(time.perf_counter() - t0)
         return self._dispatch(replica, args, kwargs)
 
     def remote_with_key(self, routing_key: str, *args, **kwargs):
@@ -693,16 +756,22 @@ class DeploymentHandle:
         prefix-aware LLM routing; falls back to pow-2 with one replica)."""
         import hashlib
 
-        self._refresh()
-        if not self._replicas:
-            replica = self._pick()  # waits for replicas / raises
-            return self._dispatch(replica, args, kwargs)
-        if len(self._replicas) > 1:
-            digest = hashlib.md5(routing_key.encode()).digest()
-            replica = self._replicas[
-                int.from_bytes(digest[:4], "little") % len(self._replicas)]
-        else:
-            replica = self._pick()
+        from ray_tpu.util import tracing
+
+        t0 = time.perf_counter()
+        with tracing.profile("serve.route", category="serve",
+                             deployment=self._name):
+            self._refresh()
+            if not self._replicas:
+                replica = self._pick()  # waits for replicas / raises
+            elif len(self._replicas) > 1:
+                digest = hashlib.md5(routing_key.encode()).digest()
+                replica = self._replicas[
+                    int.from_bytes(digest[:4], "little")
+                    % len(self._replicas)]
+            else:
+                replica = self._pick()
+        _obs()["route"].observe(time.perf_counter() - t0)
         return self._dispatch(replica, args, kwargs)
 
     def broadcast(self, method_name: str, *args, timeout: float = 120.0,
@@ -726,6 +795,9 @@ class DeploymentHandle:
     def _dispatch(self, replica, args, kwargs):
         # pending counters decay by zeroing at each periodic refresh
         self._pending[replica] = self._pending.get(replica, 0) + 1
+        # dispatch timestamp rides the request so the replica can record
+        # the built-in serve.queue span (popped before user code sees it)
+        kwargs = {**kwargs, "_serve_submit_ts": time.time()}
         blob = cloudpickle.dumps((args, kwargs))
         if self._stream:
             # ObjectRefGenerator of chunk refs, produced as the replica

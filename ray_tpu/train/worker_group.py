@@ -108,26 +108,30 @@ class TrainWorker:
         surviving state is pulled back by the NEXT incarnation, resharded
         onto its (smaller) mesh, via ``pull_weight_shards``."""
         from ray_tpu.train.scaling_policy import mesh_spec_for
+        from ray_tpu.util import tracing
         from ray_tpu.weights import (ShardedTreeSpec, WeightStore,
                                      publish_host_shards)
         from ray_tpu.weights.spec import flatten_tree, host_boxes
         import numpy as np
 
-        mesh = mesh_spec_for(self.world_size)
-        skeleton, leaves = flatten_tree(shard_tree)
-        parts, meta, shards = {}, {}, {}
-        host = mesh.hosts[self.rank]
-        for path, leaf in leaves.items():
-            arr = np.asarray(leaf)
-            parts[path] = ("data",) + (None,) * (arr.ndim - 1)
-            meta[path] = ((arr.shape[0] * self.world_size,) + arr.shape[1:],
-                          arr.dtype.str)
-        spec = ShardedTreeSpec(mesh=mesh, parts=parts, meta=meta)
-        for path, leaf in leaves.items():
-            box = host_boxes(spec.mesh, parts[path], meta[path][0], host)[0]
-            shards[path] = {box: np.asarray(leaf)}
-        publish_host_shards(WeightStore(store_name), version, spec, host,
-                            shards, skeleton=skeleton, durable=durable)
+        with tracing.profile("train.publish", category="train",
+                             store=store_name, version=version):
+            mesh = mesh_spec_for(self.world_size)
+            skeleton, leaves = flatten_tree(shard_tree)
+            parts, meta, shards = {}, {}, {}
+            host = mesh.hosts[self.rank]
+            for path, leaf in leaves.items():
+                arr = np.asarray(leaf)
+                parts[path] = ("data",) + (None,) * (arr.ndim - 1)
+                meta[path] = ((arr.shape[0] * self.world_size,)
+                              + arr.shape[1:], arr.dtype.str)
+            spec = ShardedTreeSpec(mesh=mesh, parts=parts, meta=meta)
+            for path, leaf in leaves.items():
+                box = host_boxes(spec.mesh, parts[path], meta[path][0],
+                                 host)[0]
+                shards[path] = {box: np.asarray(leaf)}
+            publish_host_shards(WeightStore(store_name), version, spec, host,
+                                shards, skeleton=skeleton, durable=durable)
         return version
 
     def pull_weight_shards(self, store_name: str,

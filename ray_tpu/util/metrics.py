@@ -108,6 +108,21 @@ class Histogram(_Metric):
                 "boundaries": list(self.boundaries)}
 
 
+def reset_after_fork():
+    """Zero every instrument's recorded values (instruments stay
+    registered — module-level holders keep their references). A
+    zygote-forked worker inherits the parent image's registry; without
+    this reset the child's first auto-publish re-reports the zygote's
+    accumulated counts under a fresh proc key, double-counting them in
+    ``/metrics``."""
+    with _lock:
+        for m in _registry.values():
+            for attr in ("_values", "_counts", "_sums"):
+                d = getattr(m, attr, None)
+                if isinstance(d, dict):
+                    d.clear()
+
+
 def scrape_metrics() -> Dict[str, dict]:
     """All metrics registered in this process."""
     with _lock:

@@ -77,6 +77,53 @@ def summarize_tasks(job_id: Optional[str] = None) -> Dict[str, Any]:
                      30.0)
 
 
+def metrics_history(name: Optional[str] = None,
+                    window_s: Optional[float] = None,
+                    tier: str = "auto") -> Any:
+    """Metric time-series from the GCS history ring (reference surface:
+    the dashboard's ``/api/metrics/history``). Without ``name``, returns
+    the list of recorded metric names. With it, returns ``{"name",
+    "kind", "tier", "interval_s", "points"}`` — ``tier="raw"`` is the
+    fine ring (default 5 s cadence), ``"rollup"`` the coarse one (default
+    60 s: avg/min/max for gauges, cumulative + rate for counters and
+    histograms), ``"auto"`` picks raw while the window fits in it."""
+    core = _core()
+    if not name:
+        return core._run(core._gcs_call("GetMetricsHistory", {}),
+                         30.0)["names"]
+    return core._run(core._gcs_call("GetMetricsHistory", {
+        "name": name, "window_s": window_s, "tier": tier}), 30.0)["history"]
+
+
+def cluster_health(scan: bool = False) -> Dict[str, Any]:
+    """Latest cluster-health report from the GCS monitor: stuck tasks
+    (RUNNING far past the per-function p99), straggler raylets
+    (lease-queue/loop-lag outliers, lagging heartbeats), and
+    provisioning-pool pathology (dead zygote, starved warm pool).
+    ``scan=True`` forces a scan now instead of returning the last
+    periodic one (``health_scan_interval_s``)."""
+    core = _core()
+    return core._run(core._gcs_call("GetClusterHealth", {"scan": scan}),
+                     60.0)["health"]
+
+
+def get_timeline(job_id: Optional[str] = None,
+                 start_ts: Optional[float] = None,
+                 end_ts: Optional[float] = None,
+                 spans: bool = True, limit: int = 5000) -> Dict[str, Any]:
+    """Perfetto-loadable chrome-trace JSON of the task flow graph from the
+    GCS task-event ring (+ built-in spans) — the ``/api/timeline``
+    surface, callable from a driver. Dump it with ``json.dump`` and open
+    in ui.perfetto.dev."""
+    core = _core()
+    req: Dict[str, Any] = {"job_id": job_id, "spans": spans, "limit": limit}
+    if start_ts is not None:
+        req["start_ts"] = start_ts
+    if end_ts is not None:
+        req["end_ts"] = end_ts
+    return core._run(core._gcs_call("GetTimeline", req), 60.0)
+
+
 def get_node_stats(node_address: str, agent: bool = False) -> Dict[str, Any]:
     """Raylet-side stats; agent=True adds the per-node agent sample (node
     cpu/mem/load + per-worker cpu/rss, reference: dashboard

@@ -105,6 +105,25 @@ def enable():
     _enabled = True
 
 
+def reset_after_fork():
+    """Drop every piece of state a forked child inherits from its parent's
+    span pipeline. A zygote-forked worker shares the parent's buffer, flush
+    counter, proc tag, AND a dangling ``_timer`` reference (the timer
+    thread does not survive the fork, so the child would believe a flush
+    is armed and never arm one again) — without this reset the child
+    re-ships the zygote's buffered spans and clobbers the parent's GCS
+    flush keys (same class of bug as core_worker's ``_obs_proc_tag``)."""
+    global _timer, _flush_counter, _last_flush, _proc_tag
+    with _lock:
+        _buffer.clear()
+        _timer = None  # parent's timer thread is gone in the child
+        _flush_counter = 0
+        _last_flush = time.time()
+        _proc_tag = uuid.uuid4().hex[:10]
+    del _local_spans[:]
+    _ctx.set(None)
+
+
 # -- tail-span protection: without this, spans recorded in the last
 # _FLUSH_INTERVAL_S before process exit die with the pending _timer --
 _atexit_registered = False
@@ -311,15 +330,12 @@ def clear():
 _SPAN_META = ("name", "cat", "ts", "dur", "pid", "tid")
 
 
-def export_chrome_trace(path: str) -> int:
-    """Write a chrome://tracing (about://tracing, Perfetto) JSON file.
-
-    Besides the ``ph: "X"`` duration slices, every parent→child span edge
-    that crosses a thread or process emits a flow-event pair (``ph: "s"`` on
-    the parent slice, ``ph: "f"`` on the child slice) so cross-process
-    causality — driver submit → actor task → nested task — renders as
-    arrows. Returns the number of events written."""
-    spans = get_spans()
+def spans_to_chrome_events(spans: List[dict],
+                           flow_id_base: int = 0) -> List[dict]:
+    """Convert span records to chrome-trace events (``ph: "X"`` slices +
+    flow-event pairs for cross-track parent→child edges). Shared by the
+    driver-side :func:`export_chrome_trace` and the GCS timeline endpoint
+    (``GET /api/timeline``), which merges these with task-event slices."""
     events = [
         {
             "name": s["name"],
@@ -334,7 +350,7 @@ def export_chrome_trace(path: str) -> int:
         for s in spans
     ]
     by_id = {s["span_id"]: s for s in spans if s.get("span_id")}
-    flow_n = 0
+    flow_n = flow_id_base
     for s in spans:
         parent = by_id.get(s.get("parent_id") or "")
         if parent is None:
@@ -358,6 +374,18 @@ def export_chrome_trace(path: str) -> int:
             "id": flow_n, "ts": s["ts"] * 1e6, "pid": s.get("pid", 0),
             "tid": s.get("tid", 0),
         })
+    return events
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write a chrome://tracing (about://tracing, Perfetto) JSON file.
+
+    Besides the ``ph: "X"`` duration slices, every parent→child span edge
+    that crosses a thread or process emits a flow-event pair (``ph: "s"`` on
+    the parent slice, ``ph: "f"`` on the child slice) so cross-process
+    causality — driver submit → actor task → nested task — renders as
+    arrows. Returns the number of events written."""
+    events = spans_to_chrome_events(get_spans())
     with open(path, "w") as f:
         json.dump({"traceEvents": events}, f)
     return len(events)

@@ -26,6 +26,7 @@ dashboard's ``/api/weights``.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -43,6 +44,35 @@ from ray_tpu.weights.spec import (
 
 _STORE_PREFIX = "rtpu_weight_store:"
 _KEEP_VERSIONS = 2  # committed versions retained (older chunks freed)
+
+_obs_lock = threading.Lock()
+_obs_metrics: Optional[dict] = None
+
+
+def _obs() -> dict:
+    """Lazily-created weight-plane metrics on the shared registry (always
+    on: every publish/pull edge lands in ``/metrics``)."""
+    global _obs_metrics
+    with _obs_lock:
+        if _obs_metrics is None:
+            from ray_tpu.util.metrics import Histogram
+
+            bounds = [0.01, 0.1, 1, 10, 100]
+            _obs_metrics = {
+                "publish": Histogram(
+                    "ray_tpu.weights.publish_seconds",
+                    "one publisher's chunk publish into a weight store",
+                    boundaries=bounds),
+                "pull": Histogram(
+                    "ray_tpu.weights.pull_seconds",
+                    "one consumer's chunk pull/assembly from a weight "
+                    "store", boundaries=bounds),
+                "reshard": Histogram(
+                    "ray_tpu.weights.reshard_seconds",
+                    "collective/XLA-tier reshard execution",
+                    boundaries=bounds),
+            }
+        return _obs_metrics
 
 
 def _encode_box(box: Box) -> str:
@@ -313,19 +343,25 @@ class WeightStore:
     def _publish_chunks(self, version: int, skeleton: Any,
                         spec: ShardedTreeSpec, chunks: Dict[str, np.ndarray],
                         num_chunks: int, durable: bool, timeout: float):
-        ray_tpu.get(self._actor.begin.remote(
-            version, skeleton, _spec_payload(spec), num_chunks),
-            timeout=timeout)
-        if durable:
-            # ship bytes; the store re-puts so refs survive this process
-            ray_tpu.get(self._actor.put_chunks.remote(version, chunks),
-                        timeout=timeout)
-        else:
-            refs = {k: [ray_tpu.put(a)] for k, a in chunks.items()}
-            nbytes = {k: int(a.nbytes) for k, a in chunks.items()}
-            dtypes = {k: a.dtype.str for k, a in chunks.items()}
-            ray_tpu.get(self._actor.register_chunks.remote(
-                version, refs, nbytes, dtypes), timeout=timeout)
+        from ray_tpu.util import tracing
+
+        t0 = time.perf_counter()
+        with tracing.profile("weights.publish", category="weights",
+                             store=self.name, version=version):
+            ray_tpu.get(self._actor.begin.remote(
+                version, skeleton, _spec_payload(spec), num_chunks),
+                timeout=timeout)
+            if durable:
+                # ship bytes; the store re-puts so refs survive this process
+                ray_tpu.get(self._actor.put_chunks.remote(version, chunks),
+                            timeout=timeout)
+            else:
+                refs = {k: [ray_tpu.put(a)] for k, a in chunks.items()}
+                nbytes = {k: int(a.nbytes) for k, a in chunks.items()}
+                dtypes = {k: a.dtype.str for k, a in chunks.items()}
+                ray_tpu.get(self._actor.register_chunks.remote(
+                    version, refs, nbytes, dtypes), timeout=timeout)
+        _obs()["publish"].observe(time.perf_counter() - t0)
 
     # -- consume -------------------------------------------------------
 
@@ -347,24 +383,31 @@ class WeightStore:
         """Assemble the FULL tree of ``version`` (default: latest). Only
         for replicated consumers — sharded consumers use
         :meth:`pull_shards` and never hold a gathered array."""
-        man = self.manifest(version)
-        leaves: Dict[str, np.ndarray] = {}
-        spec = _spec_from_payload(man["spec"])
-        pulled = 0
-        by_leaf: Dict[str, List[Tuple[Box, dict]]] = {}
-        for key, c in man["chunks"].items():
-            leaf, box = _split_key(key)
-            by_leaf.setdefault(leaf, []).append((box, c))
-        for leaf, (shape, dtype) in spec.meta.items():
-            out = np.empty(shape, dtype=np.dtype(dtype))
-            for box, c in by_leaf.get(leaf, ()):
-                val = np.asarray(ray_tpu.get(c["ref"][0], timeout=timeout))
-                out[box_slices(box)] = val.reshape(
-                    tuple(b - a for a, b in box))
-                pulled += c["nbytes"]
-            leaves[leaf] = out
-        self._actor.note_pull.remote(man["version"], pulled)
-        tree = unflatten_tree(man["skeleton"], leaves)
+        from ray_tpu.util import tracing
+
+        t0 = time.perf_counter()
+        with tracing.profile("weights.pull", category="weights",
+                             store=self.name):
+            man = self.manifest(version)
+            leaves: Dict[str, np.ndarray] = {}
+            spec = _spec_from_payload(man["spec"])
+            pulled = 0
+            by_leaf: Dict[str, List[Tuple[Box, dict]]] = {}
+            for key, c in man["chunks"].items():
+                leaf, box = _split_key(key)
+                by_leaf.setdefault(leaf, []).append((box, c))
+            for leaf, (shape, dtype) in spec.meta.items():
+                out = np.empty(shape, dtype=np.dtype(dtype))
+                for box, c in by_leaf.get(leaf, ()):
+                    val = np.asarray(ray_tpu.get(c["ref"][0],
+                                                 timeout=timeout))
+                    out[box_slices(box)] = val.reshape(
+                        tuple(b - a for a, b in box))
+                    pulled += c["nbytes"]
+                leaves[leaf] = out
+            self._actor.note_pull.remote(man["version"], pulled)
+            tree = unflatten_tree(man["skeleton"], leaves)
+        _obs()["pull"].observe(time.perf_counter() - t0)
         return (tree, man["version"]) if return_version else tree
 
     def pull_shards(self, dst_spec: ShardedTreeSpec, host: str,
@@ -374,40 +417,45 @@ class WeightStore:
         the intersecting published chunks. Returns
         ``{leaf: {dst_box: array}}``; never materializes a full leaf unless
         the destination box IS the full leaf."""
+        from ray_tpu.util import tracing
         from ray_tpu.weights.spec import (host_boxes, intersect_box,
                                           rel_slices)
 
-        man = self.manifest(version)
-        spec = _spec_from_payload(man["spec"])
-        by_leaf: Dict[str, List[Tuple[Box, dict]]] = {}
-        for key, c in man["chunks"].items():
-            leaf, box = _split_key(key)
-            by_leaf.setdefault(leaf, []).append((box, c))
-        out: Dict[str, Dict[Box, np.ndarray]] = {}
-        pulled = 0
-        cache: Dict[str, np.ndarray] = {}
-        for leaf, (shape, dtype) in dst_spec.meta.items():
-            dt = np.dtype(dtype)
-            out[leaf] = {}
-            for dbox in host_boxes(dst_spec.mesh, dst_spec.part_of(leaf),
-                                   shape, host):
-                shard = np.empty(tuple(b - a for a, b in dbox), dtype=dt)
-                for cbox, c in by_leaf.get(leaf, ()):
-                    inter = intersect_box(dbox, cbox)
-                    if inter is None:
-                        continue
-                    key = _chunk_key(leaf, cbox)
-                    chunk = cache.get(key)
-                    if chunk is None:
-                        chunk = np.asarray(
-                            ray_tpu.get(c["ref"][0], timeout=timeout)
-                        ).reshape(tuple(b - a for a, b in cbox))
-                        cache[key] = chunk
-                        pulled += c["nbytes"]
-                    shard[rel_slices(inter, dbox)] = chunk[
-                        rel_slices(inter, cbox)]
-                out[leaf][dbox] = shard
-        self._actor.note_pull.remote(man["version"], pulled)
+        t0 = time.perf_counter()
+        with tracing.profile("weights.pull", category="weights",
+                             store=self.name, host=host):
+            man = self.manifest(version)
+            spec = _spec_from_payload(man["spec"])
+            by_leaf: Dict[str, List[Tuple[Box, dict]]] = {}
+            for key, c in man["chunks"].items():
+                leaf, box = _split_key(key)
+                by_leaf.setdefault(leaf, []).append((box, c))
+            out: Dict[str, Dict[Box, np.ndarray]] = {}
+            pulled = 0
+            cache: Dict[str, np.ndarray] = {}
+            for leaf, (shape, dtype) in dst_spec.meta.items():
+                dt = np.dtype(dtype)
+                out[leaf] = {}
+                for dbox in host_boxes(dst_spec.mesh, dst_spec.part_of(leaf),
+                                       shape, host):
+                    shard = np.empty(tuple(b - a for a, b in dbox), dtype=dt)
+                    for cbox, c in by_leaf.get(leaf, ()):
+                        inter = intersect_box(dbox, cbox)
+                        if inter is None:
+                            continue
+                        key = _chunk_key(leaf, cbox)
+                        chunk = cache.get(key)
+                        if chunk is None:
+                            chunk = np.asarray(
+                                ray_tpu.get(c["ref"][0], timeout=timeout)
+                            ).reshape(tuple(b - a for a, b in cbox))
+                            cache[key] = chunk
+                            pulled += c["nbytes"]
+                        shard[rel_slices(inter, dbox)] = chunk[
+                            rel_slices(inter, cbox)]
+                    out[leaf][dbox] = shard
+            self._actor.note_pull.remote(man["version"], pulled)
+        _obs()["pull"].observe(time.perf_counter() - t0)
         return (out, man["version"]) if return_version else out
 
     def subscribe(self, start_after: Optional[int] = None

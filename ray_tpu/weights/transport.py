@@ -150,36 +150,44 @@ def collective_reshard(plan: TransferPlan, group, host: str,
     recvs — the CPU store tier parks receivers without spinning, the XLA
     tier leaves tensors parked in the sender's device store.
     """
+    import time as _time
+
+    from ray_tpu.util import tracing
+    from ray_tpu.weights.store import _obs
+
     src_hosts = plan.src.mesh.hosts
     if tuple(plan.dst.mesh.hosts) != tuple(src_hosts):
         raise ValueError(
             "collective_reshard needs identical src/dst host sets; use the "
             "object-plane transport for cross-mesh moves")
-    rank_of = {h: i for i, h in enumerate(src_hosts)}
-    me = rank_of[host]
-    for tag, e in enumerate(plan.edges):
-        if e.local or rank_of[e.src_host] != me:
-            continue
-        chunk = _cut(e.box, e.src_box, shards[e.leaf][e.src_box])
-        group.send(chunk, rank_of[e.dst_host], tag=tag)
-    out: Dict[str, Dict[Box, np.ndarray]] = {}
-    for leaf, (shape, dtype) in plan.dst.meta.items():
-        out[leaf] = {
-            dbox: np.empty(tuple(b - a for a, b in dbox),
-                           dtype=np.dtype(dtype))
-            for dbox in host_boxes(plan.dst.mesh, plan.dst.part_of(leaf),
-                                   shape, host)}
-    for tag, e in enumerate(plan.edges):
-        if e.dst_host != host:
-            continue
-        dst = out[e.leaf][e.dst_box]
-        if e.local:
-            dst[rel_slices(e.box, e.dst_box)] = \
-                shards[e.leaf][e.src_box][rel_slices(e.box, e.src_box)]
-        else:
-            chunk = np.asarray(group.recv(rank_of[e.src_host], tag=tag))
-            dst[rel_slices(e.box, e.dst_box)] = chunk.reshape(
-                tuple(b - a for a, b in e.box))
+    t0 = _time.perf_counter()
+    with tracing.profile("weights.reshard", category="weights", host=host):
+        rank_of = {h: i for i, h in enumerate(src_hosts)}
+        me = rank_of[host]
+        for tag, e in enumerate(plan.edges):
+            if e.local or rank_of[e.src_host] != me:
+                continue
+            chunk = _cut(e.box, e.src_box, shards[e.leaf][e.src_box])
+            group.send(chunk, rank_of[e.dst_host], tag=tag)
+        out: Dict[str, Dict[Box, np.ndarray]] = {}
+        for leaf, (shape, dtype) in plan.dst.meta.items():
+            out[leaf] = {
+                dbox: np.empty(tuple(b - a for a, b in dbox),
+                               dtype=np.dtype(dtype))
+                for dbox in host_boxes(plan.dst.mesh, plan.dst.part_of(leaf),
+                                       shape, host)}
+        for tag, e in enumerate(plan.edges):
+            if e.dst_host != host:
+                continue
+            dst = out[e.leaf][e.dst_box]
+            if e.local:
+                dst[rel_slices(e.box, e.dst_box)] = \
+                    shards[e.leaf][e.src_box][rel_slices(e.box, e.src_box)]
+            else:
+                chunk = np.asarray(group.recv(rank_of[e.src_host], tag=tag))
+                dst[rel_slices(e.box, e.dst_box)] = chunk.reshape(
+                    tuple(b - a for a, b in e.box))
+    _obs()["reshard"].observe(_time.perf_counter() - t0)
     return out
 
 
@@ -195,19 +203,27 @@ def jax_reshard(tree: Any, mesh_axes: Dict[str, int],
     ``jax.device_put`` per leaf — XLA plans the collective exchange
     (the ICI lowering; on the CPU test tier this runs over the 8-device
     virtual mesh). ``mesh_axes`` is name->size over ``jax.devices()``."""
+    import time as _time
+
+    from ray_tpu.util import tracing
     from ray_tpu.utils import import_jax
+    from ray_tpu.weights.store import _obs
 
     jax = import_jax()
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-    names = tuple(mesh_axes)
-    shape = tuple(mesh_axes[n] for n in names)
-    devices = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
-    mesh = Mesh(devices, names)
-    skeleton, leaves = flatten_tree(tree)
-    out = {}
-    for path, leaf in leaves.items():
-        part = parts.get(path, default_part)
-        pspec = PartitionSpec(*part) if part else PartitionSpec()
-        out[path] = jax.device_put(leaf, NamedSharding(mesh, pspec))
-    return unflatten_tree(skeleton, out)
+    t0 = _time.perf_counter()
+    with tracing.profile("weights.reshard", category="weights"):
+        names = tuple(mesh_axes)
+        shape = tuple(mesh_axes[n] for n in names)
+        devices = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+        mesh = Mesh(devices, names)
+        skeleton, leaves = flatten_tree(tree)
+        out = {}
+        for path, leaf in leaves.items():
+            part = parts.get(path, default_part)
+            pspec = PartitionSpec(*part) if part else PartitionSpec()
+            out[path] = jax.device_put(leaf, NamedSharding(mesh, pspec))
+        result = unflatten_tree(skeleton, out)
+    _obs()["reshard"].observe(_time.perf_counter() - t0)
+    return result

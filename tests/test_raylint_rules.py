@@ -1391,3 +1391,75 @@ def test_sup001_stale_filewide_directive():
         x = 1
     """, rules=["TRC001", "SUP001"])
     assert rules_of(findings) == ["SUP001"]
+
+
+# ---------------------------------------------------------------------------
+# OBS001 — metric naming + static span names
+# ---------------------------------------------------------------------------
+
+
+def test_obs001_positive_unprefixed_and_undescribed_metric():
+    findings = lint("""
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+        hits = Counter("cache_hits", "cache hit count")
+        depth = Gauge("ray_tpu_queue_depth")
+        lat = Histogram("ray_tpu.rpc.latency_seconds", "")
+    """, rules=["OBS001"])
+    assert rules_of(findings) == ["OBS001"] * 3
+    assert "ray_tpu_" in findings[0].message       # missing prefix
+    assert "description" in findings[1].message    # missing description
+    assert "description" in findings[2].message    # empty description
+
+
+def test_obs001_positive_dynamic_metric_name_and_fstring_span():
+    findings = lint("""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter
+
+        def make(name, request_id):
+            c = Counter(f"ray_tpu_{name}", "per-thing counter")
+            with tracing.profile(f"handle:{request_id}"):
+                pass
+            with tracing.profile("handle", request=request_id):
+                pass
+    """, rules=["OBS001"])
+    assert rules_of(findings) == ["OBS001"] * 2
+    assert "static string" in findings[0].message
+    assert "cardinality" in findings[1].message
+
+
+def test_obs001_negative_clean_instruments():
+    findings = lint("""
+        import collections
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        c = Counter("ray_tpu_worker_pool_hits", "warm-pool adoption hits")
+        h = Histogram("ray_tpu.train.step_seconds", "train step wall time",
+                      boundaries=[0.01, 0.1, 1])
+        freq = collections.Counter("not a metric at all")
+
+        def f(store):
+            with tracing.profile("weights.pull", category="weights",
+                                 store=store):
+                pass
+    """, rules=["OBS001"])
+    assert findings == []
+
+
+def test_obs001_scope_and_suppression():
+    # outside ray_tpu/ the rule stands down (tools, tests, benches)
+    findings = lint("""
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("bench_probe", "")
+    """, relpath="tools/bench_obs.py", rules=["OBS001"])
+    assert findings == []
+    # a reasoned suppression holds
+    findings = lint("""
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("legacy_name", "kept for dashboard compat")  # raylint: disable=OBS001 grandfathered series name
+    """, rules=["OBS001"])
+    assert findings == []

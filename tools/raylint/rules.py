@@ -838,3 +838,85 @@ class CheckpointWriteOutsideHelper(Rule):
                     f"directly on a checkpoint-plane path; use "
                     f"`ckpt.manifest.atomic_write`"))
         return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# OBS001: observability hygiene — metric naming and static span names
+# ---------------------------------------------------------------------------
+
+_OBS_METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+_OBS_NAME_PREFIXES = ("ray_tpu_", "ray_tpu.")
+
+
+def _call_arg(node: ast.Call, index: int, keyword: str) -> Optional[ast.AST]:
+    if len(node.args) > index:
+        return node.args[index]
+    for kw in node.keywords:
+        if kw.arg == keyword:
+            return kw.value
+    return None
+
+
+@register_rule
+class ObservabilityHygiene(Rule):
+    name = "OBS001"
+    summary = ("observability hygiene: metric instruments must carry the "
+               "ray_tpu prefix and a non-empty description, and "
+               "tracing.profile() span names must be static strings — an "
+               "f-string per request/task is a cardinality bomb in every "
+               "span consumer (GCS ring, timeline, Perfetto)")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not module.path.startswith("ray_tpu/"):
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = module.resolver.dotted(node.func) or ""
+            terminal = _terminal(dotted)
+            # metrics constructors: resolved through util.metrics (so
+            # collections.Counter and friends never match)
+            if terminal in _OBS_METRIC_CTORS and "metrics" in dotted:
+                findings.extend(self._check_metric(module, node, terminal))
+            elif terminal == "profile" and "tracing" in dotted:
+                findings.extend(self._check_span(module, node))
+        return iter(findings)
+
+    def _check_metric(self, module: Module, node: ast.Call,
+                      ctor: str) -> List[Finding]:
+        out: List[Finding] = []
+        name = _call_arg(node, 0, "name")
+        if not (isinstance(name, ast.Constant)
+                and isinstance(name.value, str)):
+            out.append(self.finding(
+                module, node,
+                f"{ctor} name must be a static string literal (the "
+                f"ray_tpu prefix convention is unverifiable otherwise, "
+                f"and dynamic names multiply Prometheus series)"))
+        elif not name.value.startswith(_OBS_NAME_PREFIXES):
+            out.append(self.finding(
+                module, node,
+                f"metric `{name.value}` must carry the `ray_tpu_` prefix "
+                f"(every exported series is namespaced; unprefixed names "
+                f"collide with user/app metrics in /metrics)"))
+        desc = _call_arg(node, 1, "description")
+        if desc is None or (isinstance(desc, ast.Constant)
+                            and not str(desc.value or "").strip()):
+            out.append(self.finding(
+                module, node,
+                f"{ctor} needs a non-empty description — it renders as "
+                f"the `# HELP` line of the Prometheus exposition"))
+        return out
+
+    def _check_span(self, module: Module, node: ast.Call) -> List[Finding]:
+        name = _call_arg(node, 0, "name")
+        if isinstance(name, ast.Constant) and isinstance(name.value, str):
+            return []
+        return [self.finding(
+            module, node,
+            "tracing.profile() span name must be a static string — "
+            "f-strings/concatenation mint one span NAME per request or "
+            "task (cardinality bomb in the GCS span table and every "
+            "timeline view); put the variable part in span kwargs, e.g. "
+            "profile(\"pull\", store=name)")]
