@@ -21,8 +21,10 @@ from ray_tpu.train.context import (
     report,
 )
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TrainingFailedError
+from ray_tpu.train import pipeline  # lazy package: MPMD pipeline parallelism
 
 __all__ = [
+    "pipeline",
     "JaxTrainer",
     "DataParallelTrainer",
     "TrainingFailedError",

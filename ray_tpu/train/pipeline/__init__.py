@@ -1,0 +1,49 @@
+"""ray_tpu.train.pipeline: MPMD pipeline-parallel training across actor
+meshes — stage partitioning, the 1F1B schedule, stage actors streaming
+microbatches over the channel plane, and the recovering controller. See
+``ray_tpu/train/pipeline/README.md`` for the design.
+
+Public surface::
+
+    from ray_tpu.train.pipeline import (
+        PipelineConfig, PipelineTrainer, build_schedule, simulate)
+
+    trainer = PipelineTrainer(cfg, PipelineConfig(num_stages=2,
+                                                  num_microbatches=8),
+                              ckpt_root="/mnt/ckpts/run1")
+    stats = trainer.train(num_steps=1000)
+"""
+
+# Lazy exports (PEP 562): stage/controller pull in ray_tpu actors + jax;
+# schedule/partition geometry must stay importable anywhere (raylint,
+# benches, the schedule golden tests) without that weight.
+_EXPORTS = {
+    "Op": "schedule", "build_schedule": "schedule", "simulate": "schedule",
+    "bubble_upper_bound": "schedule",
+    "max_inflight_activations": "schedule",
+    "partition_layers": "partition", "stage_param_keys": "partition",
+    "split_params": "partition", "merge_params": "partition",
+    "StagePrograms": "partition", "make_stage_optimizer": "partition",
+    "PipelineStage": "stage",
+    "PipelineConfig": "controller", "PipelineTrainer": "controller",
+    "make_microbatches": "controller",
+    "repartition_manifest_leaves": "controller",
+}
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module 'ray_tpu.train.pipeline' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"ray_tpu.train.pipeline.{mod}"),
+                   name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
+
+__all__ = list(_EXPORTS)

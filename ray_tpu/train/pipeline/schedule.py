@@ -1,0 +1,148 @@
+"""1F1B pipeline schedule: per-stage op streams + an analytic simulator.
+
+Reference: "Scaling Deep Learning Training with MPMD Pipeline Parallelism"
+(PAPERS.md, arxiv 2412.14374) — the classic one-forward-one-backward
+schedule (PipeDream-flush / Megatron "1F1B"): stage ``s`` of ``S`` runs
+``S-1-s`` warmup forwards, then alternates one forward with one backward
+until microbatches run out, then drains the remaining backwards. Peak
+in-flight activations per stage are bounded by ``S-s`` (not ``M``), and
+the bubble fraction is ``(S-1)/(S-1+M)`` with equal fwd/bwd-per-microbatch
+costs.
+
+Everything here is pure geometry: the schedule is a list of
+:class:`Op` per stage, wire-encodable (plain tuples), golden-testable,
+and executable by :mod:`ray_tpu.train.pipeline.stage` against real
+channels or by :func:`simulate` against a cost model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple
+
+# op kinds, in the vocabulary the stage executor understands
+RECV_F = "recv_f"  # read activations for microbatch mb from upstream
+FWD = "fwd"        # run this stage's forward for mb (stash input for bwd)
+SEND_F = "send_f"  # write mb's activations downstream
+RECV_B = "recv_b"  # read mb's output-gradient from downstream
+BWD = "bwd"        # run this stage's backward for mb (accumulate grads)
+SEND_B = "send_b"  # write mb's input-gradient upstream
+
+KINDS = (RECV_F, FWD, SEND_F, RECV_B, BWD, SEND_B)
+
+
+class Op(NamedTuple):
+    kind: str
+    mb: int
+
+
+def _stage_ops(stage: int, num_stages: int, num_microbatches: int
+               ) -> List[Op]:
+    S, M, s = num_stages, num_microbatches, stage
+    first, last = s == 0, s == S - 1
+    ops: List[Op] = []
+
+    def fwd(i: int):
+        if not first:
+            ops.append(Op(RECV_F, i))
+        ops.append(Op(FWD, i))
+        if not last:
+            ops.append(Op(SEND_F, i))
+
+    def bwd(i: int):
+        if not last:
+            ops.append(Op(RECV_B, i))
+        ops.append(Op(BWD, i))
+        if not first:
+            ops.append(Op(SEND_B, i))
+
+    warmup = min(S - 1 - s, M)
+    for i in range(warmup):
+        fwd(i)
+    for i in range(warmup, M):  # steady 1F1B
+        fwd(i)
+        bwd(i - warmup)
+    for i in range(M - warmup, M):  # cooldown
+        bwd(i)
+    return ops
+
+
+def build_schedule(num_stages: int, num_microbatches: int
+                   ) -> List[List[Op]]:
+    """Per-stage op lists for a 1F1B step. ``num_microbatches`` >= 1;
+    stages with fewer microbatches than warmup slots degrade gracefully
+    (pure fwd-then-bwd)."""
+    if num_stages < 1 or num_microbatches < 1:
+        raise ValueError(
+            f"need >=1 stage and >=1 microbatch, got S={num_stages} "
+            f"M={num_microbatches}")
+    return [_stage_ops(s, num_stages, num_microbatches)
+            for s in range(num_stages)]
+
+
+def max_inflight_activations(stage: int, num_stages: int) -> int:
+    """Upper bound on microbatch inputs stage ``stage`` holds at once
+    under 1F1B (its warmup depth + the one in flight)."""
+    return num_stages - stage
+
+
+def bubble_upper_bound(num_stages: int, num_microbatches: int) -> float:
+    """The analytic 1F1B bubble fraction with equal per-microbatch stage
+    costs: (S-1)/(S-1+M)."""
+    S, M = num_stages, num_microbatches
+    return (S - 1) / float(S - 1 + M)
+
+
+def simulate(num_stages: int, num_microbatches: int,
+             t_fwd: float = 1.0, t_bwd: float = 2.0,
+             t_comm: float = 0.0) -> Dict[str, object]:
+    """Event-driven dry run of the schedule under rendezvous semantics:
+    a recv waits for the matching send's completion time, sends complete
+    ``t_comm`` after being posted. Returns the makespan, per-stage busy
+    fractions, and the overall bubble fraction (idle compute across
+    stages / total stage-time) — the number PIPE_r* reports and the
+    1F1B acceptance bound checks against."""
+    sched = build_schedule(num_stages, num_microbatches)
+    cost = {FWD: t_fwd, BWD: t_bwd,
+            RECV_F: 0.0, RECV_B: 0.0, SEND_F: t_comm, SEND_B: t_comm}
+    ready: Dict[object, float] = {}  # (kind, stage, mb) -> msg-available time
+    clock = [0.0] * num_stages
+    busy = [0.0] * num_stages
+    pos = [0] * num_stages
+    remaining = sum(len(ops) for ops in sched)
+    while remaining:
+        progressed = False
+        for s, ops in enumerate(sched):
+            while pos[s] < len(ops):
+                kind, mb = ops[pos[s]]
+                if kind == RECV_F:
+                    key = (SEND_F, s - 1, mb)
+                elif kind == RECV_B:
+                    key = (SEND_B, s + 1, mb)
+                else:
+                    key = None
+                if key is not None:
+                    if key not in ready:
+                        break  # blocked on an unposted send; try next stage
+                    clock[s] = max(clock[s], ready.pop(key))
+                clock[s] += cost[kind]
+                if kind in (FWD, BWD):
+                    busy[s] += cost[kind]
+                if kind in (SEND_F, SEND_B):
+                    ready[(kind, s, mb)] = clock[s]
+                pos[s] += 1
+                remaining -= 1
+                progressed = True
+        if not progressed:
+            raise RuntimeError(
+                "schedule deadlocked in simulation — a recv waits on a "
+                "send no stage will post (schedule generator bug)")
+    makespan = max(clock)
+    total_busy = sum(busy)
+    bubble = 1.0 - total_busy / (makespan * num_stages) if makespan else 0.0
+    return {
+        "makespan": makespan,
+        "busy_per_stage": busy,
+        "busy_fraction_per_stage": [b / makespan if makespan else 0.0
+                                    for b in busy],
+        "bubble_fraction": bubble,
+    }

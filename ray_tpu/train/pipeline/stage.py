@@ -1,0 +1,396 @@
+"""PipelineStage: one actor gang member executing a 1F1B op stream.
+
+Each stage is an actor owning one partition of the model (see
+partition.py), its own optimizer state, and the channel endpoints to its
+neighbors. Microbatch activations flow stage->stage over the compiled-graph
+channel plane (``dag/channels.py``): the shm seqlock slot on one node, the
+worker-mailbox push channel across nodes — writer-creates, reader-attaches,
+and the depth-1 reader-ack backpressure is exactly the rendezvous the 1F1B
+schedule needs (a stage can run at most one send ahead of its consumer).
+
+Observability: every op lands as a built-in span — ``pipe.fwd`` /
+``pipe.bwd`` bound the compute, ``pipe.send`` / ``pipe.recv`` bound the
+channel hops, all tagged ``stage``/``mb``/``step``. The sender ships its
+span context in the payload and the receiver parents ``pipe.recv`` onto
+it, so ``/api/timeline`` renders every microbatch hand-off as a matched
+cross-process flow arrow (PR 10's span plumbing, no pipeline-specific
+timeline code).
+
+Failure model: stages are stateless between steps modulo (params,
+opt_state, step), which the controller checkpoints per stage through the
+ckpt plane. A dead stage kills the step (channel reads time out / actor
+death surfaces on the controller's ray.get); recovery re-forms the whole
+gang at a fresh channel generation and restores every stage from its
+manifest — mid-schedule partial work is discarded by construction (grads
+only apply at the step boundary).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.train.pipeline import schedule as sched
+
+
+def _chan_names(run: str, generation: int, stage: int, num_stages: int
+                ) -> Dict[str, Optional[str]]:
+    """Channel names for this stage's four possible endpoints. ``f<s>``
+    carries stage s -> s+1 activations, ``b<s>`` carries s+1 -> s
+    gradients; the writer side creates the slot."""
+    g = f"{run}.g{generation}"
+    return {
+        "fwd_out": f"{g}.f{stage}" if stage < num_stages - 1 else None,
+        "bwd_out": f"{g}.b{stage - 1}" if stage > 0 else None,
+        "fwd_in": f"{g}.f{stage - 1}" if stage > 0 else None,
+        "bwd_in": f"{g}.b{stage}" if stage < num_stages - 1 else None,
+    }
+
+
+def channel_shm_paths(run: str, generation: int, num_stages: int
+                      ) -> List[str]:
+    """The /dev/shm paths a same-node gang's channels occupy (the
+    controller unlinks them after killing a gang — a dead writer cannot)."""
+    out = []
+    for s in range(num_stages):
+        names = _chan_names(run, generation, s, num_stages)
+        for key in ("fwd_out", "bwd_out"):
+            if names[key]:
+                out.append(f"/dev/shm/rtpu_chan_{names[key]}")
+    return out
+
+
+@ray_tpu.remote
+class PipelineStage:
+    def __init__(self, stage: int, num_stages: int, cfg_blob: bytes,
+                 opt_blob: Optional[bytes], run_name: str, generation: int,
+                 channel_capacity: int = 4 << 20,
+                 boundaries: Optional[list] = None):
+        # driver-authored blobs: decode only through the audited
+        # serialization boundary (raylint SER001)
+        from ray_tpu._private.serialization import loads_trusted
+
+        self.stage = stage
+        self.num_stages = num_stages
+        self.cfg = loads_trusted(cfg_blob)
+        self._opt_factory = (loads_trusted(opt_blob) if opt_blob
+                             else None)
+        self.run_name = run_name
+        self.generation = generation
+        self.channel_capacity = channel_capacity
+        self.boundaries = ([tuple(b) for b in boundaries]
+                           if boundaries else None)
+        self.programs = None
+        self.params = None
+        self.opt_state = None
+        self.step = 0
+        self._chans: Dict[str, Any] = {}
+        self._acc = None  # accumulated grads across a step's microbatches
+        self._inputs: Dict[int, Any] = {}  # mb -> stashed fwd input
+        self._last_losses: List[float] = []
+
+    # -- gang formation -------------------------------------------------
+
+    def ready(self) -> bool:
+        return True
+
+    def create_channels(self) -> bool:
+        """Writer side: create this stage's outgoing slots. Runs on every
+        stage BEFORE any reader attaches."""
+        from ray_tpu.dag.channels import Channel
+
+        names = _chan_names(self.run_name, self.generation, self.stage,
+                            self.num_stages)
+        for key in ("fwd_out", "bwd_out"):
+            if names[key] is not None:
+                self._chans[key] = Channel(
+                    names[key], capacity=self.channel_capacity,
+                    create=True, num_readers=1)
+        return True
+
+    def open_channels(self, timeout: float = 30.0) -> bool:
+        """Reader side: attach to the neighbors' slots (they were created
+        by create_channels on every stage first; the retry only covers
+        filesystem visibility)."""
+        from ray_tpu.dag.channels import Channel
+
+        names = _chan_names(self.run_name, self.generation, self.stage,
+                            self.num_stages)
+        deadline = time.monotonic() + timeout
+        for key in ("fwd_in", "bwd_in"):
+            if names[key] is None:
+                continue
+            while True:
+                try:
+                    self._chans[key] = Channel(names[key], reader_slot=0)
+                    break
+                except FileNotFoundError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
+        return True
+
+    def _build_programs(self):
+        if self.programs is None:
+            from ray_tpu.train.pipeline.partition import (
+                StagePrograms, make_stage_optimizer)
+
+            opt = (self._opt_factory() if self._opt_factory
+                   else make_stage_optimizer())
+            self.programs = StagePrograms(
+                self.cfg, self.stage, self.num_stages, opt,
+                boundaries=self.boundaries)
+
+    def init_weights(self, store_name: str,
+                     version: Optional[int] = None) -> int:
+        """Pull this stage's parameter subtree from its weight-plane store
+        (the per-stage weight placement path) and init fresh optimizer
+        state for it."""
+        from ray_tpu.weights import WeightStore
+
+        self._build_programs()
+        tree, version = WeightStore(store_name).pull(version,
+                                                     return_version=True)
+        self.params = tree["params"]
+        self.opt_state = self.programs.opt_init(self.params)
+        self.step = 0
+        return version
+
+    # -- schedule execution ---------------------------------------------
+
+    def _send(self, key: str, mb: int, payload, step: int, nbytes: int):
+        from ray_tpu.util import tracing
+
+        ctx = tracing.current_context()
+        span_id = tracing.new_span_id()
+        trace_id = ctx[0] if ctx else tracing.new_trace_id()
+        t0 = time.time()
+        self._chans[key].write({"mb": mb, "data": payload,
+                                "trace": (trace_id, span_id)})
+        tracing.record_span(
+            "pipe.send", t0, time.time(), category="pipe",
+            trace_id=trace_id, span_id=span_id,
+            parent_id=ctx[1] if ctx else None,
+            stage=self.stage, mb=mb, step=step, nbytes=nbytes)
+
+    def _recv(self, key: str, mb: int, step: int):
+        from ray_tpu.util import tracing
+
+        t0 = time.time()
+        msg = self._chans[key].read()
+        if msg["mb"] != mb:
+            raise RuntimeError(
+                f"stage {self.stage} expected microbatch {mb} on {key}, "
+                f"got {msg['mb']} — schedule/channel desync")
+        tr = msg.get("trace")
+        tracing.record_span(
+            "pipe.recv", t0, time.time(), category="pipe",
+            trace_id=tr[0] if tr else tracing.new_trace_id(),
+            span_id=tracing.new_span_id(),
+            parent_id=tr[1] if tr else None,
+            stage=self.stage, mb=mb, step=step)
+        return msg["data"]
+
+    def run_schedule(self, step: int, ops: List, microbatches: Optional[List[dict]] = None) -> Dict[str, Any]:
+        """Execute one step's op stream (``schedule.build_schedule`` row
+        for this stage). ``microbatches`` carries the per-microbatch
+        host data this stage consumes: ``tokens`` on the first stage,
+        ``targets``/``mask`` on the last."""
+        from ray_tpu.util import tracing
+
+        self._build_programs()
+        if self.params is None:
+            raise RuntimeError(
+                f"stage {self.stage}: init_weights/restore before running")
+        jax = _jax()
+        p = self.programs
+        wall0 = time.perf_counter()
+        compute_s = send_s = recv_s = 0.0
+        send_bytes = recv_bytes = 0
+        losses: List[float] = []
+        auxes: List[float] = []
+        self._acc = None
+        self._inputs.clear()
+        for kind, mb in ops:
+            if kind == sched.RECV_F:
+                t0 = time.perf_counter()
+                x = self._recv("fwd_in", mb, step)
+                recv_s += time.perf_counter() - t0
+                recv_bytes += x.nbytes
+                self._inputs[mb] = x
+            elif kind == sched.FWD:
+                if self.stage == 0:
+                    self._inputs[mb] = microbatches[mb]["tokens"]
+                x = self._inputs[mb]
+                t0 = time.perf_counter()
+                with tracing.profile("pipe.fwd", category="pipe",
+                                     stage=self.stage, mb=mb, step=step):
+                    if p.last:
+                        # stash only: the BWD value_and_grad computes the
+                        # loss — F and B are adjacent here, a separate
+                        # forward would double this stage's compute
+                        self._y = None
+                    else:
+                        y, aux = p.fwd(self.params, x)
+                        jax.block_until_ready(y)
+                        auxes.append(float(aux))
+                        self._y = np.asarray(y)
+                compute_s += time.perf_counter() - t0
+            elif kind == sched.SEND_F:
+                t0 = time.perf_counter()
+                self._send("fwd_out", mb, self._y, step, self._y.nbytes)
+                send_bytes += self._y.nbytes
+                self._y = None
+                send_s += time.perf_counter() - t0
+            elif kind == sched.RECV_B:
+                t0 = time.perf_counter()
+                dy = self._recv("bwd_in", mb, step)
+                recv_s += time.perf_counter() - t0
+                recv_bytes += dy.nbytes
+                self._dy = dy
+            elif kind == sched.BWD:
+                x = self._inputs.pop(mb)
+                t0 = time.perf_counter()
+                with tracing.profile("pipe.bwd", category="pipe",
+                                     stage=self.stage, mb=mb, step=step):
+                    if p.last:
+                        loss, aux, dparams, dx = p.bwd(
+                            self.params, x, microbatches[mb]["targets"],
+                            microbatches[mb]["mask"])
+                        losses.append(float(loss))
+                        auxes.append(float(aux))
+                    elif p.first:
+                        dparams, dx = p.bwd(self.params, x, self._dy), None
+                        self._dy = None
+                    else:
+                        dparams, dx = p.bwd(self.params, x, self._dy)
+                        self._dy = None
+                    self._acc = (dparams if self._acc is None
+                                 else p.acc_grads(self._acc, dparams))
+                    jax.block_until_ready(self._acc)
+                    self._dx = None if dx is None else np.asarray(dx)
+                compute_s += time.perf_counter() - t0
+            elif kind == sched.SEND_B:
+                t0 = time.perf_counter()
+                self._send("bwd_out", mb, self._dx, step, self._dx.nbytes)
+                send_bytes += self._dx.nbytes
+                self._dx = None
+                send_s += time.perf_counter() - t0
+            else:
+                raise ValueError(f"unknown schedule op {kind!r}")
+        self._last_losses = losses
+        return {
+            "stage": self.stage,
+            "losses": losses,
+            "aux": auxes,
+            "wall_s": time.perf_counter() - wall0,
+            "compute_s": compute_s,
+            "send_s": send_s,
+            "recv_wait_s": recv_s,
+            "send_bytes": send_bytes,
+            "recv_bytes": recv_bytes,
+        }
+
+    # -- step boundary ---------------------------------------------------
+
+    def grad_sqnorm(self) -> float:
+        if self._acc is None:
+            raise RuntimeError(f"stage {self.stage}: no accumulated grads")
+        return float(self.programs.grad_sqnorm(self._acc))
+
+    def apply_grads(self, scale: float) -> int:
+        """Scale the accumulated grads (1/M and the coordinated global
+        clip, folded into one factor by the controller) and step the
+        optimizer."""
+        if self._acc is None:
+            raise RuntimeError(f"stage {self.stage}: no accumulated grads")
+        self.params, self.opt_state = self.programs.opt_apply(
+            self._acc, scale, self.opt_state, self.params)
+        self._acc = None
+        self.step += 1
+        return self.step
+
+    # -- ckpt plane -------------------------------------------------------
+
+    def save_ckpt(self, ckpt_root: str, step: int) -> str:
+        """Per-stage checkpoint through the ckpt plane: one manifest over
+        content-addressed chunks per stage, spec-tagged with this stage's
+        geometry so a restore onto a different gang shape reshards
+        no-gather (ckpt.restore_shards)."""
+        from ray_tpu import ckpt
+        from ray_tpu.weights.spec import MeshSpec, ShardedTreeSpec
+
+        tree = {"params": self.params, "opt_state": self.opt_state,
+                "step": np.int64(step)}
+        spec = ShardedTreeSpec.from_tree(
+            tree, MeshSpec.host_mesh([f"stage{self.stage}"]))
+        store = ckpt.CheckpointStore(
+            os.path.join(ckpt_root, f"stage{self.stage}"),
+            name=f"{self.run_name}-s{self.stage}")
+        man = ckpt.save_checkpoint(store, tree, step=step, spec=spec)
+        return man.ckpt_id
+
+    def restore_ckpt(self, ckpt_root: str,
+                     target_step: Optional[int] = None) -> Optional[int]:
+        """Restore (params, opt_state, step) from this stage's latest
+        manifest — or, with ``target_step``, the newest manifest at or
+        below it (the controller's rollback when a crash mid-save left
+        stages disagreeing). None when no usable checkpoint exists (the
+        caller falls back to weight-plane init)."""
+        from ray_tpu import ckpt
+
+        self._build_programs()
+        store = ckpt.CheckpointStore(
+            os.path.join(ckpt_root, f"stage{self.stage}"),
+            name=f"{self.run_name}-s{self.stage}")
+        manifest = store.latest()
+        if target_step is not None:
+            cands = [m for m in store.list() if m.step <= target_step]
+            manifest = max(cands, key=lambda m: m.step) if cands else None
+        if manifest is None:
+            return None
+        tree = ckpt.restore_tree(store, manifest.ckpt_id)
+        self.params = tree["params"]
+        self.opt_state = tree["opt_state"]
+        self.step = int(tree["step"])
+        return self.step
+
+    # -- introspection / teardown ----------------------------------------
+
+    def state_digest(self) -> Dict[str, float]:
+        """Cheap content fingerprint for tests (param/opt sums + step)."""
+        jax = _jax()
+
+        psum = float(sum(np.asarray(a, dtype=np.float64).sum()
+                         for a in jax.tree.leaves(self.params)))
+        return {"step": self.step, "param_sum": psum}
+
+    def pull_params(self) -> Dict[str, Any]:
+        """This stage's param subtree as host arrays (tests, small
+        models; production consumers go through the weight plane)."""
+        jax = _jax()
+
+        return jax.tree.map(lambda a: np.asarray(a), self.params)
+
+    def close_channels(self, unlink: bool = False) -> bool:
+        for chan in self._chans.values():
+            try:
+                chan.close(unlink=unlink)
+            except Exception:
+                pass
+        self._chans.clear()
+        return True
+
+    def shutdown(self) -> bool:
+        self.close_channels(unlink=True)
+        return True
+
+
+def _jax():
+    from ray_tpu.utils import import_jax
+
+    return import_jax()

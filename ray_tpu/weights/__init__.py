@@ -25,13 +25,17 @@ Public surface::
 # processes that never move weights.
 _EXPORTS = {
     "TransferEdge": "plan", "TransferPlan": "plan", "plan_reshard": "plan",
+    "DcnCostModel": "plan", "RedistributionProgram": "plan",
+    "ReshardLoweringError": "plan", "lower_collective": "plan",
+    "maybe_lower_collective": "plan", "lowering_fallback_counts": "plan",
     "MeshSpec": "spec", "ShardedTreeSpec": "spec",
     "flatten_tree": "spec", "unflatten_tree": "spec",
     "WeightStore": "store", "WeightStoreActor": "store",
     "WeightSubscription": "store",
     "collective_reshard": "transport", "jax_reshard": "transport",
     "local_shards_of": "transport", "publish_host_shards": "transport",
-    "pull_with_locals": "transport",
+    "pull_with_locals": "transport", "redistribute": "transport",
+    "reshard_lowering_stats": "transport",
 }
 
 
@@ -58,6 +62,12 @@ __all__ = [
     "WeightStoreActor",
     "WeightSubscription",
     "plan_reshard",
+    "DcnCostModel",
+    "RedistributionProgram",
+    "ReshardLoweringError",
+    "lower_collective",
+    "maybe_lower_collective",
+    "lowering_fallback_counts",
     "flatten_tree",
     "unflatten_tree",
     "local_shards_of",
@@ -65,4 +75,6 @@ __all__ = [
     "pull_with_locals",
     "collective_reshard",
     "jax_reshard",
+    "redistribute",
+    "reshard_lowering_stats",
 ]

@@ -22,8 +22,11 @@ the collective tier (same mesh) or to chunked object-plane puts/pulls.
 
 from __future__ import annotations
 
+import logging
+import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.weights.spec import (
     Box,
@@ -34,6 +37,8 @@ from ray_tpu.weights.spec import (
     intersect_box,
     unique_boxes,
 )
+
+logger = logging.getLogger("ray_tpu.weights")
 
 
 @dataclass(frozen=True)
@@ -204,3 +209,204 @@ def plan_reshard(src: ShardedTreeSpec, dst: ShardedTreeSpec) -> TransferPlan:
                         box=inter, src_box=sbox, dst_box=dbox,
                         nbytes=nbytes, local=False))
     return plan
+
+
+# ---------------------------------------------------------------------------
+# Collective lowering: plan -> redistribution program
+#
+# Reference: "Memory-efficient array redistribution through portable
+# collective communication" (PAPERS.md, arxiv 2112.01075) — a sharding
+# transition is a *program* of cheap point-to-point exchanges, never a
+# replicate-then-slice (XLA's "involuntary full rematerialization"
+# fallback). The lowering here turns a TransferPlan into ordered rounds of
+# edges such that (a) the plan is proven no-gather BEFORE any byte moves
+# and (b) no host's in-flight send+recv bytes in one round exceed a bound,
+# so the peak working set stays a constant factor over the resident shards
+# regardless of how adversarial the (src, dst) geometry pair is.
+# ---------------------------------------------------------------------------
+
+
+class ReshardLoweringError(ValueError):
+    """The plan cannot be lowered to a no-gather collective program (some
+    host would materialize a full non-replicated leaf)."""
+
+
+@dataclass(frozen=True)
+class DcnCostModel:
+    """Two-tier bandwidth model for redistribution edges.
+
+    Hosts mapping to the same node (``node_of``; default: every host its
+    own node, i.e. everything is DCN) exchange over the fast tier (ICI /
+    intra-slice); everything else crosses the data-center network. Costs
+    are advisory — they order edges (long DCN transfers first, so they
+    overlap the cheap intra-node ones) and price programs for the
+    transport picker; they never change what bytes move.
+    """
+
+    ici_bytes_per_s: float = 40e9
+    dcn_bytes_per_s: float = 3e9
+    latency_s: float = 200e-6
+    node_of: Optional[Callable[[str], str]] = None
+
+    def _node(self, host: str) -> str:
+        return self.node_of(host) if self.node_of is not None else host
+
+    def is_dcn(self, edge: TransferEdge) -> bool:
+        return self._node(edge.src_host) != self._node(edge.dst_host)
+
+    def edge_seconds(self, edge: TransferEdge) -> float:
+        if edge.local:
+            return 0.0
+        bw = self.dcn_bytes_per_s if self.is_dcn(edge) \
+            else self.ici_bytes_per_s
+        return self.latency_s + edge.nbytes / bw
+
+
+@dataclass
+class RedistributionProgram:
+    """A lowered TransferPlan: ordered rounds of non-local edge indices.
+
+    Within a round every sender posts its sends then drains its recvs; a
+    host does not enter round ``r+1`` before finishing round ``r``, which
+    is what bounds its in-flight bytes. The program is computed (and its
+    invariants assertable) before any data movement."""
+
+    plan: TransferPlan
+    rounds: List[List[int]] = field(default_factory=list)
+    est_seconds: float = 0.0
+    dcn_bytes: int = 0
+    ici_bytes: int = 0
+
+    def max_round_host_bytes(self) -> int:
+        """Peak per-(host, round) in-flight bytes (sends + recvs)."""
+        peak = 0
+        for rnd in self.rounds:
+            per_host: Dict[str, int] = {}
+            for i in rnd:
+                e = self.plan.edges[i]
+                per_host[e.src_host] = per_host.get(e.src_host, 0) + e.nbytes
+                per_host[e.dst_host] = per_host.get(e.dst_host, 0) + e.nbytes
+            peak = max(peak, max(per_host.values(), default=0))
+        return peak
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "num_rounds": len(self.rounds),
+            "num_edges": sum(len(r) for r in self.rounds),
+            "est_seconds": self.est_seconds,
+            "dcn_bytes": self.dcn_bytes,
+            "ici_bytes": self.ici_bytes,
+            "max_round_host_bytes": self.max_round_host_bytes(),
+        }
+
+
+def lower_collective(plan: TransferPlan,
+                     cost_model: Optional[DcnCostModel] = None,
+                     inflight_limit_bytes: int = 64 << 20,
+                     ) -> RedistributionProgram:
+    """Lower ``plan`` into a :class:`RedistributionProgram`.
+
+    Asserts ``plan.no_gather()`` BEFORE lowering — a plan that would
+    gather must never reach a transport (raising here is what keeps the
+    XLA replicate-then-slice rematerialization fallback dead; see
+    :func:`maybe_lower_collective` for the logged fallback).
+
+    Edge order inside the round stream: DCN edges first (they are the
+    long poles — issuing them early overlaps them with the intra-node
+    traffic), then by descending size. Greedy round packing keeps every
+    host's per-round send+recv bytes under ``inflight_limit_bytes``
+    (a single edge larger than the limit gets a round of its own rather
+    than being rejected — it must move regardless).
+    """
+    if not plan.no_gather():
+        raise ReshardLoweringError(
+            "reshard plan is not no-gather: some host would materialize a "
+            "full copy of a non-replicated leaf; refusing to lower to "
+            "collectives (and never falling back to replicate-then-slice)")
+    cm = cost_model or DcnCostModel()
+    indexed = [(i, e) for i, e in enumerate(plan.edges) if not e.local]
+    indexed.sort(key=lambda ie: (not cm.is_dcn(ie[1]), -ie[1].nbytes,
+                                 ie[0]))
+    rounds: List[List[int]] = []
+    loads: List[Dict[str, int]] = []  # per-round per-host in-flight bytes
+    for i, e in indexed:
+        placed = False
+        for rnd, load in zip(rounds, loads):
+            if (load.get(e.src_host, 0) + e.nbytes <= inflight_limit_bytes
+                    and load.get(e.dst_host, 0) + e.nbytes
+                    <= inflight_limit_bytes):
+                rnd.append(i)
+                load[e.src_host] = load.get(e.src_host, 0) + e.nbytes
+                load[e.dst_host] = load.get(e.dst_host, 0) + e.nbytes
+                placed = True
+                break
+        if not placed:
+            rounds.append([i])
+            # non-local edges always cross hosts (a same-host intersection
+            # is a local edge by construction), so two distinct keys
+            loads.append({e.src_host: e.nbytes, e.dst_host: e.nbytes})
+    dcn = sum(e.nbytes for _, e in indexed if cm.is_dcn(e))
+    ici = sum(e.nbytes for _, e in indexed if not cm.is_dcn(e))
+    # est: per round, the slowest host's serialized send time; rounds are
+    # sequential by construction
+    est = 0.0
+    for rnd in rounds:
+        per_host: Dict[str, float] = {}
+        for i in rnd:
+            e = plan.edges[i]
+            per_host[e.src_host] = per_host.get(e.src_host, 0.0) \
+                + cm.edge_seconds(e)
+        est += max(per_host.values(), default=0.0)
+    return RedistributionProgram(plan=plan, rounds=rounds, est_seconds=est,
+                                 dcn_bytes=dcn, ici_bytes=ici)
+
+
+# fallback accounting: every place the collective lowering is bypassed is
+# counted and logged (rate-limited) — the MULTICHIP_r05 regression was a
+# *silent* XLA rematerialization on sharding transitions; silence is the bug
+_fallback_lock = threading.Lock()
+_fallback_counts: Dict[str, int] = {}
+_fallback_last_log: Dict[str, float] = {}
+_FALLBACK_LOG_INTERVAL_S = 60.0
+
+
+def note_lowering_fallback(reason: str, detail: str = "") -> None:
+    """Record (and rate-limited-log) one lowering fallback. Never silent:
+    the first occurrence of each reason logs immediately, repeats at most
+    once per minute per reason."""
+    now = time.time()
+    with _fallback_lock:
+        _fallback_counts[reason] = _fallback_counts.get(reason, 0) + 1
+        count = _fallback_counts[reason]
+        last = _fallback_last_log.get(reason, 0.0)
+        if now - last < _FALLBACK_LOG_INTERVAL_S:
+            return
+        _fallback_last_log[reason] = now
+    logger.warning(
+        "weights reshard: collective lowering fell back (%s, %d so far)%s",
+        reason, count, f": {detail}" if detail else "")
+
+
+def lowering_fallback_counts() -> Dict[str, int]:
+    with _fallback_lock:
+        return dict(_fallback_counts)
+
+
+def reset_lowering_fallback_counts() -> None:
+    with _fallback_lock:
+        _fallback_counts.clear()
+        _fallback_last_log.clear()
+
+
+def maybe_lower_collective(plan: TransferPlan,
+                           cost_model: Optional[DcnCostModel] = None,
+                           inflight_limit_bytes: int = 64 << 20,
+                           ) -> Optional[RedistributionProgram]:
+    """Best-effort lowering: returns None (after a rate-limited log, never
+    silently) when the plan cannot be lowered no-gather. Callers that get
+    None fall back to their legacy path knowingly."""
+    try:
+        return lower_collective(plan, cost_model, inflight_limit_bytes)
+    except ReshardLoweringError as e:
+        note_lowering_fallback("plan_not_no_gather", str(e))
+        return None

@@ -24,11 +24,18 @@ SURVEY.md's CPU/ICI split):
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from ray_tpu.weights.plan import TransferPlan, plan_reshard
+from ray_tpu.weights.plan import (
+    RedistributionProgram,
+    TransferPlan,
+    maybe_lower_collective,
+    note_lowering_fallback,
+    plan_reshard,
+)
 from ray_tpu.weights.spec import (
     Box,
     ShardedTreeSpec,
@@ -136,8 +143,81 @@ def pull_with_locals(store: WeightStore, version: Optional[int],
 # ---------------------------------------------------------------------------
 
 
+def _alloc_dst(plan: TransferPlan, host: str
+               ) -> Dict[str, Dict[Box, np.ndarray]]:
+    out: Dict[str, Dict[Box, np.ndarray]] = {}
+    for leaf, (shape, dtype) in plan.dst.meta.items():
+        out[leaf] = {
+            dbox: np.empty(tuple(b - a for a, b in dbox),
+                           dtype=np.dtype(dtype))
+            for dbox in host_boxes(plan.dst.mesh, plan.dst.part_of(leaf),
+                                   shape, host)}
+    return out
+
+
+def _fill_locals(plan: TransferPlan, host: str,
+                 shards: Dict[str, Dict[Box, np.ndarray]],
+                 out: Dict[str, Dict[Box, np.ndarray]]) -> None:
+    for e in plan.edges:
+        if e.local and e.dst_host == host:
+            out[e.leaf][e.dst_box][rel_slices(e.box, e.dst_box)] = \
+                shards[e.leaf][e.src_box][rel_slices(e.box, e.src_box)]
+
+
+def redistribute(program: RedistributionProgram, group, host: str,
+                 shards: Dict[str, Dict[Box, np.ndarray]],
+                 ) -> Dict[str, Dict[Box, np.ndarray]]:
+    """Execute a lowered :class:`RedistributionProgram` over an initialized
+    collective group whose rank i is host i of BOTH meshes (src and dst
+    host sets must coincide — validated before any byte moves). The
+    program's rounds bound each host's in-flight bytes: within a round a
+    host posts its sends then drains its recvs, and a group barrier
+    between rounds keeps every host in lock-step — without it, a host
+    whose recv edges all pack into late rounds would race ahead and post
+    its entire send set eagerly, which is exactly the unbounded behavior
+    the program exists to kill. A trailing barrier fences call N from
+    call N+1 on the same group: tags are global edge indices, reused
+    verbatim by the next reshard, and the eager p2p tier OVERWRITES an
+    unconsumed slot — without the fence a fast host's next-epoch send
+    could clobber a message a slow peer has not drained yet.
+
+    Deterministic pairing: the global edge index is the p2p tag, so the
+    round structure can change without perturbing sender/receiver match-up.
+    """
+    plan = program.plan
+    if tuple(plan.dst.mesh.hosts) != tuple(plan.src.mesh.hosts):
+        raise ValueError(
+            "redistribute needs identical src/dst host sets (rank i is "
+            "host i of both meshes); use the object-plane transport for "
+            "cross-mesh moves")
+    rank_of = {h: i for i, h in enumerate(plan.src.mesh.hosts)}
+    me = rank_of[host]
+    out = _alloc_dst(plan, host)
+    _fill_locals(plan, host, shards, out)
+    for i, rnd in enumerate(program.rounds):
+        if i:
+            group.barrier()
+        for tag in rnd:
+            e = plan.edges[tag]
+            if rank_of[e.src_host] != me:
+                continue
+            chunk = _cut(e.box, e.src_box, shards[e.leaf][e.src_box])
+            group.send(chunk, rank_of[e.dst_host], tag=tag)
+        for tag in rnd:
+            e = plan.edges[tag]
+            if e.dst_host != host:
+                continue
+            chunk = np.asarray(group.recv(rank_of[e.src_host], tag=tag))
+            out[e.leaf][e.dst_box][rel_slices(e.box, e.dst_box)] = \
+                chunk.reshape(tuple(b - a for a, b in e.box))
+    if program.rounds:
+        group.barrier()  # epoch fence: tags are reusable after this
+    return out
+
+
 def collective_reshard(plan: TransferPlan, group, host: str,
                        shards: Dict[str, Dict[Box, np.ndarray]],
+                       program: Optional[RedistributionProgram] = None,
                        ) -> Dict[str, Dict[Box, np.ndarray]]:
     """Execute ``plan`` over an initialized collective group whose rank i is
     host i of BOTH meshes (src and dst hosts must coincide — the
@@ -145,10 +225,12 @@ def collective_reshard(plan: TransferPlan, group, host: str,
     on the XLA backend the payload stays device-resident at the sender until
     the receiver pulls it (no store, no driver relay).
 
-    Deterministic pairing: edges are processed in plan order with the edge
-    index as the p2p tag; every host posts all its sends, then drains its
-    recvs — the CPU store tier parks receivers without spinning, the XLA
-    tier leaves tensors parked in the sender's device store.
+    The plan is lowered to a :class:`RedistributionProgram` first (pass a
+    pre-computed ``program`` lowered from this SAME plan to share one
+    lowering across the gang) — ``no_gather()`` is asserted before any
+    byte moves and the rounds bound in-flight bytes. A plan that cannot
+    be lowered falls back to a single unbounded round (everything posted,
+    then drained) with a rate-limited warning, never silently.
     """
     import time as _time
 
@@ -160,33 +242,22 @@ def collective_reshard(plan: TransferPlan, group, host: str,
         raise ValueError(
             "collective_reshard needs identical src/dst host sets; use the "
             "object-plane transport for cross-mesh moves")
+    if program is not None and program.plan is not plan:
+        raise ValueError(
+            "collective_reshard: the pre-computed program was lowered from "
+            "a DIFFERENT plan — executing it would move the stale plan's "
+            "geometry; re-lower with lower_collective(plan)")
+    if program is None:
+        program = maybe_lower_collective(plan)  # logs on fallback
+        if program is None:
+            # plan refuses no-gather lowering (logged above): execute as
+            # one unbounded round — all sends posted, then drained
+            tags = [i for i, e in enumerate(plan.edges) if not e.local]
+            program = RedistributionProgram(plan=plan,
+                                            rounds=[tags] if tags else [])
     t0 = _time.perf_counter()
     with tracing.profile("weights.reshard", category="weights", host=host):
-        rank_of = {h: i for i, h in enumerate(src_hosts)}
-        me = rank_of[host]
-        for tag, e in enumerate(plan.edges):
-            if e.local or rank_of[e.src_host] != me:
-                continue
-            chunk = _cut(e.box, e.src_box, shards[e.leaf][e.src_box])
-            group.send(chunk, rank_of[e.dst_host], tag=tag)
-        out: Dict[str, Dict[Box, np.ndarray]] = {}
-        for leaf, (shape, dtype) in plan.dst.meta.items():
-            out[leaf] = {
-                dbox: np.empty(tuple(b - a for a, b in dbox),
-                               dtype=np.dtype(dtype))
-                for dbox in host_boxes(plan.dst.mesh, plan.dst.part_of(leaf),
-                                       shape, host)}
-        for tag, e in enumerate(plan.edges):
-            if e.dst_host != host:
-                continue
-            dst = out[e.leaf][e.dst_box]
-            if e.local:
-                dst[rel_slices(e.box, e.dst_box)] = \
-                    shards[e.leaf][e.src_box][rel_slices(e.box, e.src_box)]
-            else:
-                chunk = np.asarray(group.recv(rank_of[e.src_host], tag=tag))
-                dst[rel_slices(e.box, e.dst_box)] = chunk.reshape(
-                    tuple(b - a for a, b in e.box))
+        out = redistribute(program, group, host, shards)
     _obs()["reshard"].observe(_time.perf_counter() - t0)
     return out
 
@@ -195,14 +266,120 @@ def collective_reshard(plan: TransferPlan, group, host: str,
 # XLA tier: in-process device reshard
 # ---------------------------------------------------------------------------
 
+# per-leaf outcome counters for the device-tier reshard path. "lowered" =
+# the explicit shard-assembly redistribution ran; "fallback" = a sharded
+# jax.Array went through bare jax.device_put cross-sharding — the path
+# that can trigger XLA's "involuntary full rematerialization" warning
+# (MULTICHIP_r05). fallback must stay 0 on addressable meshes; tests
+# regression-assert it.
+_lower_lock = threading.Lock()
+_lower_counts = {"lowered": 0, "noop": 0, "host_put": 0, "fallback": 0}
+
+
+def reshard_lowering_stats() -> Dict[str, int]:
+    with _lower_lock:
+        return dict(_lower_counts)
+
+
+def reset_reshard_lowering_stats() -> None:
+    with _lower_lock:
+        for k in _lower_counts:
+            _lower_counts[k] = 0
+
+
+def _count(outcome: str) -> None:
+    with _lower_lock:
+        _lower_counts[outcome] += 1
+
+
+def _norm_box(idx: Tuple, shape: Tuple[int, ...]) -> Box:
+    """A devices_indices_map entry (tuple of slices) as a global-coords
+    box."""
+    box = []
+    for sl, dim in zip(idx, shape):
+        start, stop, _ = sl.indices(dim)
+        box.append((start, stop))
+    return tuple(box)
+
+
+def _assemble_device_shards(jax, leaf, dst_sharding):
+    """The portable-redistribution lowering of a device-tier sharding
+    transition (PAPERS.md, arxiv 2112.01075): build each destination
+    device's shard by copying exactly the intersecting slices out of the
+    source array's resident per-device shards, then bind them with
+    ``make_array_from_single_device_arrays``. XLA's resharding machinery
+    (and its replicate-then-slice "involuntary full rematerialization"
+    fallback) never runs; no buffer larger than one destination shard is
+    created unless the destination declares replication."""
+    from ray_tpu.weights.spec import intersect_box, rel_slices
+
+    shape = tuple(leaf.shape)
+    dst_map = dst_sharding.addressable_devices_indices_map(shape)
+    # dedupe replicated source shards by box BEFORE the D2H copy: a leaf
+    # replicated over N devices has N identical shards, and materializing
+    # (then overwrite-filling from) each one would multiply host traffic N×
+    src_by_box: Dict[Box, Any] = {}
+    for s in leaf.addressable_shards:
+        src_by_box.setdefault(_norm_box(s.index, shape), s.data)
+    src_pieces = [(box, np.asarray(data))
+                  for box, data in src_by_box.items()]
+    dtype = src_pieces[0][1].dtype if src_pieces else np.asarray(leaf).dtype
+    bufs = []
+    for dev, idx in dst_map.items():
+        dbox = _norm_box(idx, shape)
+        buf = np.empty(tuple(b - a for a, b in dbox), dtype=dtype)
+        for sbox, sdata in src_pieces:
+            inter = intersect_box(dbox, sbox)
+            if inter is None:
+                continue
+            buf[rel_slices(inter, dbox)] = sdata[rel_slices(inter, sbox)]
+        bufs.append(jax.device_put(buf, dev))
+    return jax.make_array_from_single_device_arrays(shape, dst_sharding,
+                                                    bufs)
+
+
+def _reshard_leaf(jax, leaf: Any, dst_sharding) -> Any:
+    """One leaf onto ``dst_sharding`` without XLA rematerialization.
+
+    Host values upload with a plain device_put (no transition exists);
+    device arrays already laid out right pass through; every other
+    addressable transition takes the explicit no-gather assembly. The
+    bare cross-sharding device_put remains only for non-addressable
+    arrays (multi-controller handoff) — counted and logged, never
+    silent."""
+    if not isinstance(leaf, jax.Array):
+        _count("host_put")
+        return jax.device_put(leaf, dst_sharding)
+    try:
+        if leaf.sharding.is_equivalent_to(dst_sharding, len(leaf.shape)):
+            _count("noop")
+            return leaf
+    except Exception:
+        pass
+    if leaf.is_fully_addressable:
+        arr = _assemble_device_shards(jax, leaf, dst_sharding)
+        _count("lowered")
+        return arr
+    _count("fallback")
+    note_lowering_fallback(
+        "device_put_cross_sharding",
+        f"non-addressable array {leaf.shape} -> {dst_sharding}; XLA may "
+        f"rematerialize")
+    return jax.device_put(leaf, dst_sharding)
+
 
 def jax_reshard(tree: Any, mesh_axes: Dict[str, int],
                 parts: Dict[str, Tuple[Optional[str], ...]],
                 default_part: Tuple[Optional[str], ...] = ()) -> Any:
-    """Reshard a pytree onto the live local device mesh via one
-    ``jax.device_put`` per leaf — XLA plans the collective exchange
-    (the ICI lowering; on the CPU test tier this runs over the 8-device
-    virtual mesh). ``mesh_axes`` is name->size over ``jax.devices()``."""
+    """Reshard a pytree onto the live local device mesh. ``mesh_axes`` is
+    name->size over ``jax.devices()``.
+
+    Sharding *transitions* (a live ``jax.Array`` moving to a different
+    layout) lower to the explicit per-shard redistribution of
+    :func:`_assemble_device_shards` instead of a bare cross-sharding
+    ``jax.device_put`` — killing the XLA replicate-then-slice
+    rematerialization fallback MULTICHIP_r05 kept logging. Host arrays
+    still upload directly (there is nothing to rematerialize)."""
     import time as _time
 
     from ray_tpu.util import tracing
@@ -223,7 +400,7 @@ def jax_reshard(tree: Any, mesh_axes: Dict[str, int],
         for path, leaf in leaves.items():
             part = parts.get(path, default_part)
             pspec = PartitionSpec(*part) if part else PartitionSpec()
-            out[path] = jax.device_put(leaf, NamedSharding(mesh, pspec))
+            out[path] = _reshard_leaf(jax, leaf, NamedSharding(mesh, pspec))
         result = unflatten_tree(skeleton, out)
     _obs()["reshard"].observe(_time.perf_counter() - t0)
     return result

@@ -1463,3 +1463,82 @@ def test_obs001_scope_and_suppression():
         c = Counter("legacy_name", "kept for dashboard compat")  # raylint: disable=OBS001 grandfathered series name
     """, rules=["OBS001"])
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# RSH001 — reshard plans proven no-gather before transport lowering
+# ---------------------------------------------------------------------------
+
+
+def test_rsh001_positive_lowering_without_assert():
+    findings = lint("""
+        from ray_tpu.weights import collective_reshard, plan_reshard
+
+        def reshard(group, host, shards, src, dst):
+            plan = plan_reshard(src, dst)
+            return collective_reshard(plan, group, host, shards)
+    """, relpath="ray_tpu/rl/sync.py", rules=["RSH001"])
+    assert rules_of(findings) == ["RSH001"]
+    assert "no_gather" in findings[0].message
+
+
+def test_rsh001_positive_restore_plan_into_lowering():
+    findings = lint("""
+        from ray_tpu.ckpt.restore import restore_plan
+        from ray_tpu.weights.plan import lower_collective
+
+        def program_for(manifest, dst_spec):
+            p = restore_plan(manifest, dst_spec)
+            return lower_collective(p, inflight_limit_bytes=1 << 20)
+    """, relpath="ray_tpu/ckpt/foo.py", rules=["RSH001"])
+    assert rules_of(findings) == ["RSH001"]
+
+
+def test_rsh001_negative_asserted_before_lowering():
+    findings = lint("""
+        from ray_tpu.weights import collective_reshard, plan_reshard
+
+        def reshard(group, host, shards, src, dst):
+            plan = plan_reshard(src, dst)
+            assert plan.no_gather(), "gathering reshard rejected"
+            return collective_reshard(plan, group, host, shards)
+
+        def reshard_guarded(group, host, shards, src, dst):
+            plan = plan_reshard(src, dst)
+            if not plan.no_gather():
+                raise ValueError("refusing gather")
+            return collective_reshard(plan, group, host, shards)
+    """, relpath="ray_tpu/rl/sync.py", rules=["RSH001"])
+    assert findings == []
+
+
+def test_rsh001_negative_plan_from_param_and_scope():
+    # a plan arriving as a parameter is the callee's contract to verify
+    # (transport.collective_reshard lowers with the internal assert);
+    # and outside ray_tpu/ the rule stands down
+    findings = lint("""
+        from ray_tpu.weights import redistribute
+
+        def run(program, plan, group, host, shards):
+            return redistribute(program, group, host, shards)
+    """, relpath="ray_tpu/weights/helper.py", rules=["RSH001"])
+    assert findings == []
+    findings = lint("""
+        from ray_tpu.weights import collective_reshard, plan_reshard
+
+        def bench(group, host, shards, src, dst):
+            plan = plan_reshard(src, dst)
+            return collective_reshard(plan, group, host, shards)
+    """, relpath="tools/bench_weights.py", rules=["RSH001"])
+    assert findings == []
+
+
+def test_rsh001_suppression():
+    findings = lint("""
+        from ray_tpu.weights import collective_reshard, plan_reshard
+
+        def broadcast(group, host, shards, src, dst):
+            plan = plan_reshard(src, dst)
+            return collective_reshard(plan, group, host, shards)  # raylint: disable=RSH001 declared broadcast: dst replicates every leaf
+    """, relpath="ray_tpu/rl/sync.py", rules=["RSH001"])
+    assert findings == []

@@ -472,3 +472,158 @@ def test_jax_reshard_on_virtual_mesh(cluster):
     assert len(w.sharding.device_set) == 8
     np.testing.assert_array_equal(np.asarray(w), tree["layer0"]["w"])
     np.testing.assert_array_equal(np.asarray(out["step"]), tree["step"])
+
+
+# ---------------------------------------------------------------------------
+# collective redistribution lowering (portable, no-gather by construction)
+# ---------------------------------------------------------------------------
+
+
+def test_lower_collective_rounds_and_cost_model():
+    from ray_tpu.weights import DcnCostModel, lower_collective
+
+    tree = {"w": np.arange(1024, dtype=np.float32).reshape(8, 128)}
+    src = ShardedTreeSpec.from_tree(
+        tree, MeshSpec((4,), ("data",), tuple(f"t{i}" for i in range(4))),
+        default_part=("data",))
+    dst = ShardedTreeSpec.from_tree(
+        tree, MeshSpec((2,), ("model",), ("s0", "s1")),
+        parts={"w": (None, "model")})
+    plan = plan_reshard(src, dst)
+    cm = DcnCostModel(node_of=lambda h: "A" if h in ("t0", "t1", "s0")
+                      else "B")
+    prog = lower_collective(plan, cm)
+    st = prog.stats()
+    # every non-local edge is scheduled exactly once
+    assert st["num_edges"] == sum(1 for e in plan.edges if not e.local)
+    assert sorted(i for rnd in prog.rounds for i in rnd) == \
+        [i for i, e in enumerate(plan.edges) if not e.local]
+    # the DCN/ICI split follows the node mapping and prices the estimate
+    assert st["dcn_bytes"] + st["ici_bytes"] == plan.bytes_moved()
+    assert st["dcn_bytes"] > 0 and st["est_seconds"] > 0
+    # a tight in-flight budget forces more rounds, each within budget
+    one_edge = max(e.nbytes for e in plan.edges if not e.local)
+    tight = lower_collective(plan, cm, inflight_limit_bytes=one_edge)
+    assert len(tight.rounds) > len(prog.rounds)
+    assert tight.max_round_host_bytes() <= one_edge
+
+
+def test_lower_collective_refuses_gather_and_logs_fallback():
+    from ray_tpu.weights import (ReshardLoweringError, lower_collective,
+                                 lowering_fallback_counts,
+                                 maybe_lower_collective)
+
+    tree = {"w": np.arange(64, dtype=np.float32).reshape(8, 8)}
+    src = ShardedTreeSpec.from_tree(
+        tree, MeshSpec((4,), ("data",), tuple(f"t{i}" for i in range(4))),
+        default_part=("data",))
+    # dst axis of size 1: one host would hold the full (non-replicated-
+    # by-declaration) leaf — the gather the lowering must refuse
+    dst = ShardedTreeSpec.from_tree(
+        tree, MeshSpec((1,), ("x",), ("g0",)), parts={"w": ("x",)})
+    plan = plan_reshard(src, dst)
+    assert not plan.no_gather()
+    with pytest.raises(ReshardLoweringError):
+        lower_collective(plan)
+    before = lowering_fallback_counts().get("plan_not_no_gather", 0)
+    assert maybe_lower_collective(plan) is None  # logged, never silent
+    assert lowering_fallback_counts()["plan_not_no_gather"] == before + 1
+
+
+@ray_tpu.remote(num_cpus=0.3)
+class _ProgramMember:
+    """Collective-group member executing a pre-lowered redistribution
+    program (the bounded-in-flight path under collective_reshard)."""
+
+    def __init__(self, rank, world, src_spec, dst_spec, limit):
+        from ray_tpu import collective as col
+        from ray_tpu.weights import lower_collective
+
+        self.rank = rank
+        self.src = src_spec
+        self.dst = dst_spec
+        plan = plan_reshard(src_spec, dst_spec)
+        assert plan.no_gather()
+        self.program = lower_collective(plan, inflight_limit_bytes=limit)
+        self.group = col.init_collective_group(world, rank, backend="cpu",
+                                               group_name="wp_redist")
+
+    def run(self):
+        from ray_tpu.weights import redistribute
+
+        host = self.src.mesh.hosts[self.rank]
+        shards = local_shards_of(_tree(), self.src, host)
+        out = redistribute(self.program, self.group, host, shards)
+        return {leaf: {str(b): a for b, a in boxes.items()}
+                for leaf, boxes in out.items()}
+
+
+def test_redistribute_program_multi_round(cluster):
+    """A byte-tight in-flight budget splits the exchange into many
+    rounds; the round-sequenced execution still lands every byte."""
+    tree = _tree()
+    mesh = MeshSpec((2,), ("x",), ("m0", "m1"))
+    src = ShardedTreeSpec.from_tree(
+        tree, mesh, parts={"layer0/w": ("x",), "layer0/b": ("x",),
+                           "step": ()})
+    dst = ShardedTreeSpec.from_tree(
+        tree, mesh, parts={"layer0/w": (None, "x"), "layer0/b": ("x",),
+                           "step": ()})
+    plan = plan_reshard(src, dst)
+    biggest = max(e.nbytes for e in plan.edges if not e.local)
+    members = [_ProgramMember.remote(i, 2, src, dst, biggest)
+               for i in range(2)]
+    out = ray_tpu.get([m.run.remote() for m in members], timeout=120)
+    for i, res in enumerate(out):
+        np.testing.assert_array_equal(
+            res["layer0/w"][f"((0, 8), ({i * 4}, {i * 4 + 4}))"],
+            tree["layer0"]["w"][:, i * 4:(i + 1) * 4])
+    for m in members:
+        ray_tpu.kill(m)
+
+
+def test_jax_reshard_transition_no_rematerialization(cluster):
+    """Regression for the MULTICHIP_r05 warning: a device-tier sharding
+    TRANSITION (live jax.Array -> different layout) must take the
+    explicit shard-assembly lowering — zero bare cross-sharding
+    device_puts, zero XLA "involuntary full rematerialization" output."""
+    import logging
+    import warnings
+
+    from ray_tpu.utils import import_jax
+    from ray_tpu.weights import jax_reshard, reshard_lowering_stats
+    from ray_tpu.weights.transport import reset_reshard_lowering_stats
+
+    jax = import_jax()
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    tree = {"w": np.arange(4096, dtype=np.float32).reshape(64, 64),
+            "b": np.arange(64, dtype=np.float32)}
+    reset_reshard_lowering_stats()
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec.getMessage())
+    root = logging.getLogger()
+    root.addHandler(handler)
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            # host upload, then two live transitions across layouts
+            t1 = jax_reshard(tree, {"data": 8}, {"w": ("data",),
+                                                 "b": ("data",)})
+            t2 = jax_reshard(t1, {"data": 4, "model": 2},
+                             {"w": ("data", "model"), "b": ("data",)})
+            t3 = jax_reshard(t2, {"data": 2, "model": 4},
+                             {"w": ("model", "data"), "b": (None,)})
+    finally:
+        root.removeHandler(handler)
+    np.testing.assert_array_equal(np.asarray(t3["w"]), tree["w"])
+    np.testing.assert_array_equal(np.asarray(t3["b"]), tree["b"])
+    stats = reshard_lowering_stats()
+    assert stats["host_put"] == 2           # the initial upload
+    assert stats["lowered"] >= 3            # every live transition
+    assert stats["fallback"] == 0           # no bare cross-sharding put
+    spill = [m for m in records if "rematerialization" in m.lower()]
+    spill += [str(w.message) for w in caught
+              if "rematerialization" in str(w.message).lower()]
+    assert not spill, spill

@@ -1,0 +1,147 @@
+"""Pipeline-parallel microbenchmark: 1F1B bubble + throughput vs single
+mesh (bench.py-style JSON output; writes PIPE_r*.json at the repo root).
+
+Measures, per stage count S (default 2 and 4, M microbatches each):
+
+- ``tokens_per_s``: end-to-end pipeline training throughput over real
+  stage actors + channels, vs the single-mesh fused ``TrainStepBundle``
+  step at the same total batch (the equal-chip-count baseline on the CPU
+  tier: both sides own the same 8 virtual devices).
+- ``bubble_fraction``: the 1F1B schedule's analytic bubble from the
+  event simulator (exactly (S-1)/(S-1+M) at equal per-microbatch costs —
+  the acceptance bound), plus the *measured* per-stage idle fraction
+  (wall - compute)/wall, which on the CPU tier also carries
+  serialization + channel costs.
+- ``activation_bytes_per_microbatch``: what one microbatch hand-off
+  puts on the wire between adjacent stages.
+
+Usage::
+
+    python tools/bench_pipeline.py [--stages 2,4] [--microbatches 8]
+        [--steps 3] [--out PIPE_r01.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _bench_cfg(n_layers: int):
+    from ray_tpu.models.transformer import CONFIGS
+
+    # n_kv_heads=4 so the single-mesh baseline shards over the default
+    # 8-device mesh's tensor=4 axis (tiny's GQA kv=2 does not divide it)
+    return dataclasses.replace(CONFIGS["tiny"], n_layers=n_layers,
+                               n_kv_heads=4, remat=False)
+
+
+def main(stages=(2, 4), microbatches: int = 8, microbatch_size: int = 2,
+         seq_len: int = 64, steps: int = 3, n_layers: int = 4,
+         out: str = None) -> list:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.parallel.mesh import create_mesh, default_mesh_axes
+    from ray_tpu.parallel.train import TrainStepBundle
+    from ray_tpu.train.pipeline import (
+        PipelineConfig,
+        PipelineTrainer,
+        bubble_upper_bound,
+        make_microbatches,
+        simulate,
+    )
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=max(8, max(stages) + 1))
+    cfg = _bench_cfg(n_layers)
+    batch_tokens = microbatches * microbatch_size * seq_len
+    rows = []
+
+    # -- single-mesh baseline (fused step, same total batch) --------------
+    mesh = create_mesh(default_mesh_axes(8))
+    bundle = TrainStepBundle(cfg, mesh, donate=False)
+    pipe0 = PipelineConfig(num_stages=1, num_microbatches=microbatches,
+                           microbatch_size=microbatch_size, seq_len=seq_len)
+    import jax
+
+    params, opt_state = bundle.init(jax.random.PRNGKey(0))
+    mbs = make_microbatches(cfg, pipe0, 0, 0)
+    batch = {k: np.concatenate([m[k] for m in mbs]) for k in mbs[0]}
+    params, opt_state, _ = bundle.step(params, opt_state, batch)  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, _ = bundle.step(params, opt_state, batch)
+    single_tps = steps * batch_tokens / (time.perf_counter() - t0)
+    rows.append({"name": "single_mesh_tokens_per_s", "value": single_tps,
+                 "unit": "tokens/s"})
+
+    # -- pipeline at each stage count -------------------------------------
+    for S in stages:
+        pipe = PipelineConfig(num_stages=S, num_microbatches=microbatches,
+                              microbatch_size=microbatch_size,
+                              seq_len=seq_len)
+        trainer = PipelineTrainer(cfg, pipe, run_name=f"bench_pipe_s{S}")
+        try:
+            trainer.train(1)  # compile + warm the channels
+            t0 = time.perf_counter()
+            stats = trainer.train(1 + steps)
+            elapsed = time.perf_counter() - t0
+            tps = steps * batch_tokens / elapsed
+            sim = simulate(S, microbatches)
+            measured_idle = float(np.mean(
+                [1.0 - c / w for c, w in
+                 zip(stats[-1]["compute_s"],
+                     [stats[-1]["wall_s"]] * S)]))
+            rows += [
+                {"name": f"pipeline_s{S}_tokens_per_s", "value": tps,
+                 "unit": "tokens/s"},
+                {"name": f"pipeline_s{S}_vs_single_mesh", "value":
+                 tps / single_tps, "unit": "x"},
+                {"name": f"pipeline_s{S}_bubble_fraction",
+                 "value": sim["bubble_fraction"], "unit": "fraction"},
+                {"name": f"pipeline_s{S}_bubble_bound",
+                 "value": bubble_upper_bound(S, microbatches),
+                 "unit": "fraction"},
+                {"name": f"pipeline_s{S}_idle_fraction_measured",
+                 "value": measured_idle, "unit": "fraction"},
+                {"name": f"pipeline_s{S}_activation_bytes_per_microbatch",
+                 "value": stats[-1]["activation_bytes_per_mb"],
+                 "unit": "bytes"},
+            ]
+        finally:
+            trainer.shutdown()
+
+    rows.append({"name": "config", "value": 0, "unit": "meta",
+                 "meta": {"n_layers": n_layers, "d_model": cfg.d_model,
+                          "microbatches": microbatches,
+                          "microbatch_size": microbatch_size,
+                          "seq_len": seq_len, "steps": steps}})
+    if out:
+        with open(out, "w") as f:
+            json.dump(rows, f, indent=1)
+    return rows
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", default="2,4")
+    ap.add_argument("--microbatches", type=int, default=8)
+    ap.add_argument("--microbatch-size", type=int, default=2)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--n-layers", type=int, default=4)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    rows = main(stages=tuple(int(s) for s in args.stages.split(",")),
+                microbatches=args.microbatches,
+                microbatch_size=args.microbatch_size,
+                seq_len=args.seq_len, steps=args.steps,
+                n_layers=args.n_layers, out=args.out)
+    print(json.dumps(rows, indent=1))

@@ -920,3 +920,78 @@ class ObservabilityHygiene(Rule):
             "task (cardinality bomb in the GCS span table and every "
             "timeline view); put the variable part in span kwargs, e.g. "
             "profile(\"pull\", store=name)")]
+
+
+# ---------------------------------------------------------------------------
+# RSH001: reshard plans must be proven no-gather before transport lowering
+# ---------------------------------------------------------------------------
+
+# calls that mint a reshard plan
+_RSH_PLAN_SOURCES = {"plan_reshard", "restore_plan"}
+# transport-lowering entry points that execute/lower a plan's data movement
+_RSH_LOWER_SINKS = {"collective_reshard", "redistribute", "lower_collective"}
+
+
+@register_rule
+class ReshardNoGatherUnasserted(Rule):
+    name = "RSH001"
+    summary = ("reshard plan reaches a transport lowering without an "
+               "explicit `plan.no_gather()` check: a plan that gathers a "
+               "full leaf onto one host is exactly the XLA "
+               "replicate-then-slice rematerialization the collective "
+               "redistribution tier exists to kill (MULTICHIP_r05) — "
+               "assert the invariant where the plan is made, or carry a "
+               "reasoned suppression")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not module.path.startswith("ray_tpu/"):
+            return iter(())
+        findings: List[Finding] = []
+        seen: set = set()
+        funcs = [n for n in ast.walk(module.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            plans: dict = {}   # var -> assignment line
+            guards: dict = {}  # var -> earliest no_gather() line
+            sinks: list = []   # (var, sink call node)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    dotted = module.resolver.dotted(node.value.func) or ""
+                    if _terminal(dotted) in _RSH_PLAN_SOURCES:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                plans[t.id] = node.lineno
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "no_gather" \
+                        and isinstance(node.func.value, ast.Name):
+                    var = node.func.value.id
+                    guards[var] = min(guards.get(var, node.lineno),
+                                      node.lineno)
+                dotted = module.resolver.dotted(node.func) or ""
+                if _terminal(dotted) in _RSH_LOWER_SINKS:
+                    for arg in list(node.args) \
+                            + [kw.value for kw in node.keywords]:
+                        if isinstance(arg, ast.Name):
+                            sinks.append((arg.id, node))
+            for var, node in sinks:
+                if var not in plans:
+                    continue  # plan came from elsewhere (param, attr)
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue  # nested-def walk saw this sink already
+                guard = guards.get(var)
+                if guard is not None and guard <= node.lineno:
+                    continue
+                seen.add(key)
+                findings.append(self.finding(
+                    module, node,
+                    f"`{var}` (a reshard plan from "
+                    f"plan_reshard/restore_plan) is lowered to a transport "
+                    f"without `{var}.no_gather()` being checked first; a "
+                    f"gathering plan must be rejected before any byte "
+                    f"moves (use weights.maybe_lower_collective for the "
+                    f"logged fallback)"))
+        return iter(findings)
