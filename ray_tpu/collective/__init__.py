@@ -38,7 +38,27 @@ __all__ = [
     "barrier",
     "ReduceOp",
     "Backend",
+    # bucketed async tier (collective/bucketed.py): lazy attrs below
+    "plan_buckets",
+    "leaf_meta",
+    "BucketPlan",
+    "Bucket",
+    "AsyncBucketReducer",
+    "ShardedBucketOptimizer",
+    "init_sharded_optimizer_groups",
 ]
+
+_BUCKETED = ("plan_buckets", "leaf_meta", "BucketPlan", "Bucket",
+             "AsyncBucketReducer", "ShardedBucketOptimizer",
+             "init_sharded_optimizer_groups")
+
+
+def __getattr__(name):  # lazy: bucketed pulls numpy/jax helpers
+    if name in _BUCKETED:
+        from ray_tpu.collective import bucketed
+
+        return getattr(bucketed, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class GroupManager:

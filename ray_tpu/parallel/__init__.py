@@ -7,7 +7,11 @@ from ray_tpu.parallel.mesh import (
     default_mesh_axes,
     named_sharding,
 )
-from ray_tpu.parallel.train import TrainStepBundle, make_optimizer
+from ray_tpu.parallel.train import (
+    TrainStepBundle,
+    make_optimizer,
+    sharded_clip_by_global_norm,
+)
 
 __all__ = [
     "AXES",
@@ -17,4 +21,5 @@ __all__ = [
     "named_sharding",
     "TrainStepBundle",
     "make_optimizer",
+    "sharded_clip_by_global_norm",
 ]

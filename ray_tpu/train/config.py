@@ -30,6 +30,14 @@ class ScalingConfig:
     # mesh-shaped sizes only: "pow2" (powers of two) or an int slice size
     # (group size must be a whole multiple — TPU slice granularity)
     elastic_granularity: Any = "pow2"
+    # bucketed grad synchronization across the group (the explicit-
+    # collective tier of the overlapped train step): backend "cpu" (CI) or
+    # "xla" (device collectives); None = off. Train loops reach it via
+    # train.get_context().grad_sync / make_bucket_reducer /
+    # make_sharded_optimizer (cross-replica sharded update: opt state
+    # 1/N per worker).
+    grad_sync_backend: Optional[str] = None
+    grad_sync_bucket_bytes: int = 32 << 20
 
     def bundle(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker)

@@ -19,7 +19,8 @@ class TrainContext:
     def __init__(self, rank: int, world_size: int, local_rank: int,
                  node_rank: int, controller, latest_checkpoint: Optional[Checkpoint],
                  config: Optional[Dict[str, Any]] = None,
-                 dataset_shards: Optional[Dict[str, Any]] = None):
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 grad_sync: Optional[Dict[str, Any]] = None):
         self.rank = rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -28,6 +29,10 @@ class TrainContext:
         self.latest_checkpoint = latest_checkpoint
         self.config = config or {}
         self.dataset_shards = dataset_shards or {}
+        # {"group": name, "world_size": N, "backend": ..., "bucket_bytes":
+        # B} when the worker group set up bucketed grad sync (the
+        # collective groups are already initialized in this process)
+        self.grad_sync = grad_sync
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -49,6 +54,54 @@ class TrainContext:
         if shard is None:
             raise KeyError(f"no dataset shard named {name!r}")
         return shard
+
+    # -- bucketed grad sync (collective/bucketed.py) ----------------------
+
+    def _require_grad_sync(self) -> Dict[str, Any]:
+        if not self.grad_sync:
+            raise RuntimeError(
+                "grad sync is not configured for this worker group — set "
+                "ScalingConfig.grad_sync_backend")
+        return self.grad_sync
+
+    def make_bucket_reducer(self, params_like: Any):
+        """An AsyncBucketReducer over this group's grad-sync plane, with a
+        bucket plan derived from ``params_like`` (every worker must build
+        it over the same tree — bucket order is the collective order).
+        Rides the dedicated ``.user`` sibling group so it can never
+        interleave with a sharded optimizer's internal reducer; keep at
+        most ONE live reducer per worker."""
+        from ray_tpu.collective.bucketed import (AsyncBucketReducer,
+                                                 leaf_meta, plan_buckets)
+
+        gs = self._require_grad_sync()
+        plan = plan_buckets(leaf_meta(params_like),
+                            bucket_bytes=gs["bucket_bytes"],
+                            world_size=self.world_size)
+        return AsyncBucketReducer(f"{gs['group']}.user", plan)
+
+    def make_sharded_optimizer(self, optimizer, params, *,
+                               clip_global_norm: Optional[float] = None,
+                               grad_scale: float = 1.0):
+        """A cross-replica ShardedBucketOptimizer: this worker keeps
+        optimizer state only for its ~1/world_size of the buckets and the
+        update pipeline overlaps bucket collectives with bucket applies.
+
+        ``optimizer`` must be a PER-LEAF transform (adam family etc.) —
+        it is applied bucket by bucket, so a cross-leaf transform like
+        ``optax.clip_by_global_norm`` inside it would clip per-bucket
+        norms; pass ``clip_global_norm=`` instead (computed globally from
+        shard-local sqnorms)."""
+        from ray_tpu.collective.bucketed import (ShardedBucketOptimizer,
+                                                 leaf_meta, plan_buckets)
+
+        gs = self._require_grad_sync()
+        plan = plan_buckets(leaf_meta(params),
+                            bucket_bytes=gs["bucket_bytes"],
+                            world_size=self.world_size)
+        return ShardedBucketOptimizer(
+            gs["group"], plan, self.rank, optimizer, params,
+            clip_global_norm=clip_global_norm, grad_scale=grad_scale)
 
 
 def set_context(ctx: Optional[TrainContext]):

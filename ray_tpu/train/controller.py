@@ -106,6 +106,15 @@ class TrainController:
                     bootstrap = scaling.use_tpu and size > 1
                 if bootstrap and size > 1:
                     group.bootstrap_distributed()
+                if scaling.grad_sync_backend and size > 1:
+                    # bucketed grad collectives for the loop (the group
+                    # name carries the restart count: a re-formed group
+                    # must not collide with the dead one's store actor)
+                    group.setup_grad_sync(
+                        f"train.grads.{self.run_dir.rsplit('/', 1)[-1]}"
+                        f".r{failures}",
+                        backend=scaling.grad_sync_backend,
+                        bucket_bytes=scaling.grad_sync_bucket_bytes)
                 self.state = "RUNNING"
                 refs = group.run(self.fn_blob, self.config, self._self_handle,
                                  self.manager.latest(), self.run_dir,

@@ -51,6 +51,11 @@ class PipelineConfig:
     step_timeout_s: float = 120.0
     max_recoveries: int = 3
     boundaries: Optional[List] = None  # explicit [start, stop) per stage
+    # size-bounded bucketed optimizer apply on every stage (None = the
+    # whole-tree apply). Per-bucket opt state + `pipe.bucket_apply` spans;
+    # bit-identical to whole-tree apply for per-leaf transforms, and the
+    # hook the stage-level dp_group replica allreduce rides on.
+    bucket_bytes: Optional[int] = None
 
     @property
     def batch_size(self) -> int:
@@ -172,7 +177,8 @@ class PipelineTrainer:
                 s, pipe.num_stages, self._cfg_blob, self._opt_blob,
                 self.run_name, self.generation,
                 channel_capacity=pipe.channel_capacity,
-                boundaries=[list(b) for b in self._bounds])
+                boundaries=[list(b) for b in self._bounds],
+                bucket_bytes=pipe.bucket_bytes)
             for s in range(pipe.num_stages)
         ]
         ray_tpu.get([a.ready.remote() for a in self.actors], timeout=120)

@@ -69,7 +69,9 @@ class PipelineStage:
     def __init__(self, stage: int, num_stages: int, cfg_blob: bytes,
                  opt_blob: Optional[bytes], run_name: str, generation: int,
                  channel_capacity: int = 4 << 20,
-                 boundaries: Optional[list] = None):
+                 boundaries: Optional[list] = None,
+                 bucket_bytes: Optional[int] = None,
+                 dp_group: Optional[Dict[str, Any]] = None):
         # driver-authored blobs: decode only through the audited
         # serialization boundary (raylint SER001)
         from ray_tpu._private.serialization import loads_trusted
@@ -84,6 +86,26 @@ class PipelineStage:
         self.channel_capacity = channel_capacity
         self.boundaries = ([tuple(b) for b in boundaries]
                            if boundaries else None)
+        # bucketed optimizer apply (None = whole-tree apply, the
+        # pre-bucketing path): grads partition into size-bounded
+        # layer-order buckets, each with its own optimizer state, applied
+        # as a pipeline — and, with ``dp_group`` (name/world_size/rank/
+        # backend of a data-parallel replica set of THIS stage), each
+        # bucket's grads allreduce asynchronously across replicas as soon
+        # as the schedule finishes, overlapping the controller's
+        # coordination round-trip. Bucket-wise apply is bit-identical to
+        # whole-tree apply for per-leaf transforms (adam family).
+        self.dp_group = dict(dp_group) if dp_group else None
+        if self.dp_group is not None and not bucket_bytes:
+            # the replica allreduce rides the bucket plan — a dp group
+            # without an explicit bound gets the default bucket size
+            from ray_tpu.collective.bucketed import DEFAULT_BUCKET_BYTES
+
+            bucket_bytes = DEFAULT_BUCKET_BYTES
+        self.bucket_bytes = bucket_bytes
+        self._bucket_plan = None
+        self._reducer = None
+        self._pending_reduce: Optional[List[Any]] = None
         self.programs = None
         self.params = None
         self.opt_state = None
@@ -145,6 +167,49 @@ class PipelineStage:
                 self.cfg, self.stage, self.num_stages, opt,
                 boundaries=self.boundaries)
 
+    def _bucketing(self):
+        """Build (lazily, params must exist) the bucket plan, per-bucket
+        param path sets, and — with a dp group — the async reducer."""
+        if self._bucket_plan is None and self.bucket_bytes:
+            from ray_tpu.collective.bucketed import (AsyncBucketReducer,
+                                                     leaf_meta, plan_buckets)
+
+            self._bucket_plan = plan_buckets(
+                leaf_meta(self.params), bucket_bytes=self.bucket_bytes,
+                world_size=(self.dp_group or {}).get("world_size", 1))
+            if self.dp_group is not None:
+                from ray_tpu import collective as col
+
+                name = f"{self.dp_group['name']}.s{self.stage}"
+                col.init_collective_group(
+                    self.dp_group["world_size"], self.dp_group["rank"],
+                    backend=self.dp_group.get("backend", "cpu"),
+                    group_name=name)
+                self._reducer = AsyncBucketReducer(name, self._bucket_plan)
+        return self._bucket_plan
+
+    def _init_opt_state(self):
+        """Whole-tree state, or one optimizer state per bucket (keyed by
+        bucket index as str so ckpt manifests serialize it plainly)."""
+        if self.bucket_bytes:
+            self._bucketing()
+            return {
+                str(b.index): self.programs.opt_init(
+                    self._subtree(b.paths))
+                for b in self._bucket_plan.buckets
+            }
+        return self.programs.opt_init(self.params)
+
+    def _flat_params(self) -> Dict[str, Any]:
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.params)
+        return {jax.tree_util.keystr(k): v for k, v in flat}
+
+    def _subtree(self, paths) -> Dict[str, Any]:
+        by_path = self._flat_params()
+        return {p: by_path[p] for p in paths}
+
     def init_weights(self, store_name: str,
                      version: Optional[int] = None) -> int:
         """Pull this stage's parameter subtree from its weight-plane store
@@ -156,7 +221,7 @@ class PipelineStage:
         tree, version = WeightStore(store_name).pull(version,
                                                      return_version=True)
         self.params = tree["params"]
-        self.opt_state = self.programs.opt_init(self.params)
+        self.opt_state = self._init_opt_state()
         self.step = 0
         return version
 
@@ -283,6 +348,13 @@ class PipelineStage:
             else:
                 raise ValueError(f"unknown schedule op {kind!r}")
         self._last_losses = losses
+        reduce_launched = False
+        if self.dp_group is not None and self._acc is not None:
+            # kick every bucket's cross-replica allreduce NOW, async: the
+            # collectives run while the controller is still collecting
+            # results and coordinating the clip across stages
+            self._launch_reduce()
+            reduce_launched = True
         return {
             "stage": self.stage,
             "losses": losses,
@@ -293,23 +365,85 @@ class PipelineStage:
             "recv_wait_s": recv_s,
             "send_bytes": send_bytes,
             "recv_bytes": recv_bytes,
+            "reduce_launched": reduce_launched,
         }
+
+    def _launch_reduce(self):
+        """Submit every bucket's grad allreduce to the async reducer (one
+        ``train.bucket_allreduce`` span per bucket lands as each
+        completes)."""
+        import jax
+
+        self._bucketing()
+        flat, _ = jax.tree_util.tree_flatten_with_path(self._acc)
+        by_path = {jax.tree_util.keystr(k): np.asarray(v) for k, v in flat}
+        self._pending_reduce = [
+            self._reducer.submit(b, {p: by_path[p] for p in b.paths})
+            for b in self._bucket_plan.buckets
+        ]
+
+    def _collect_reduced(self):
+        """Fold completed bucket allreduces back into the accumulated
+        grad tree (idempotent; no-op without a dp group)."""
+        if self._pending_reduce is None:
+            return
+        import jax
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self._acc)
+        paths = [jax.tree_util.keystr(k) for k, _ in flat]
+        reduced: Dict[str, np.ndarray] = {}
+        for handle in self._pending_reduce:
+            reduced.update(handle.result())
+        self._pending_reduce = None
+        self._acc = jax.tree_util.tree_unflatten(
+            treedef, [reduced[p] for p in paths])
 
     # -- step boundary ---------------------------------------------------
 
     def grad_sqnorm(self) -> float:
         if self._acc is None:
             raise RuntimeError(f"stage {self.stage}: no accumulated grads")
+        self._collect_reduced()  # clip must see the cross-replica sum
         return float(self.programs.grad_sqnorm(self._acc))
 
     def apply_grads(self, scale: float) -> int:
         """Scale the accumulated grads (1/M and the coordinated global
         clip, folded into one factor by the controller) and step the
-        optimizer."""
+        optimizer. With ``bucket_bytes`` set the update applies bucket by
+        bucket (per-bucket optimizer state, ``pipe.bucket_apply`` spans) —
+        bit-identical to the whole-tree apply for per-leaf transforms."""
         if self._acc is None:
             raise RuntimeError(f"stage {self.stage}: no accumulated grads")
-        self.params, self.opt_state = self.programs.opt_apply(
-            self._acc, scale, self.opt_state, self.params)
+        self._collect_reduced()
+        if not self.bucket_bytes:
+            self.params, self.opt_state = self.programs.opt_apply(
+                self._acc, scale, self.opt_state, self.params)
+            self._acc = None
+            self.step += 1
+            return self.step
+        import jax
+
+        from ray_tpu.util import tracing
+
+        self._bucketing()
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.params)
+        paths = [jax.tree_util.keystr(k) for k, _ in flat]
+        by_path = dict(zip(paths, (v for _, v in flat)))
+        gflat, _ = jax.tree_util.tree_flatten_with_path(self._acc)
+        g_by_path = {jax.tree_util.keystr(k): v for k, v in gflat}
+        for b in self._bucket_plan.buckets:
+            with tracing.profile("pipe.bucket_apply", category="pipe",
+                                 stage=self.stage, bucket=b.index,
+                                 nbytes=b.nbytes, step=self.step):
+                p_sub = {p: by_path[p] for p in b.paths}
+                g_sub = {p: g_by_path[p] for p in b.paths}
+                new_sub, self.opt_state[str(b.index)] = \
+                    self.programs.opt_apply(g_sub, scale,
+                                            self.opt_state[str(b.index)],
+                                            p_sub)
+                by_path.update(new_sub)
+        self.params = jax.tree_util.tree_unflatten(
+            treedef, [by_path[p] for p in paths])
         self._acc = None
         self.step += 1
         return self.step
@@ -355,7 +489,28 @@ class PipelineStage:
             return None
         tree = ckpt.restore_tree(store, manifest.ckpt_id)
         self.params = tree["params"]
-        self.opt_state = tree["opt_state"]
+        restored = tree["opt_state"]
+        # bucketed opt state serializes as {bucket_index_str: state}; a
+        # mode/bucket_bytes change between save and restore cannot be
+        # silently adopted (apply_grads would index the wrong shape)
+        was_bucketed = isinstance(restored, dict) and all(
+            isinstance(k, str) and k.isdigit() for k in restored)
+        if bool(self.bucket_bytes) != was_bucketed:
+            raise RuntimeError(
+                f"stage {self.stage}: checkpoint opt state is "
+                f"{'bucketed' if was_bucketed else 'whole-tree'} but this "
+                f"stage is configured {'bucketed' if self.bucket_bytes else 'whole-tree'} "
+                f"— restore with the run's original bucket_bytes setting")
+        if was_bucketed:
+            plan = self._bucketing()
+            expect = {str(b.index) for b in plan.buckets}
+            if set(restored) != expect:
+                raise RuntimeError(
+                    f"stage {self.stage}: checkpoint has buckets "
+                    f"{sorted(restored)} but the current plan has "
+                    f"{sorted(expect)} — bucket_bytes changed between "
+                    f"save and restore")
+        self.opt_state = restored
         self.step = int(tree["step"])
         return self.step
 
@@ -386,6 +541,12 @@ class PipelineStage:
         return True
 
     def shutdown(self) -> bool:
+        if self._reducer is not None:
+            try:
+                self._reducer.shutdown()
+            except Exception:
+                pass
+            self._reducer = None
         self.close_channels(unlink=True)
         return True
 

@@ -35,6 +35,30 @@ class TrainWorker:
         self.rank = rank
         self.world_size = world_size
         self._distributed = False
+        self._grad_sync: Optional[Dict[str, Any]] = None
+
+    def setup_grad_sync(self, group_name: str, backend: str,
+                        bucket_bytes: int) -> bool:
+        """Join the group's bucketed grad-sync collective (and its
+        ``.norm`` sibling for the sharded update's clip allgather + param
+        broadcasts). The train loop reaches it through
+        ``train.get_context().make_bucket_reducer`` /
+        ``make_sharded_optimizer`` (collective/bucketed.py)."""
+        from ray_tpu import collective as col
+        from ray_tpu.collective.bucketed import init_sharded_optimizer_groups
+
+        init_sharded_optimizer_groups(self.world_size, self.rank,
+                                      backend=backend, base_name=group_name)
+        # a group is dedicated to ONE reducer (ops match by sequence
+        # number): user-level bucket reducers get their own sibling so
+        # they can't interleave with a sharded optimizer's internal one
+        col.init_collective_group(self.world_size, self.rank,
+                                  backend=backend,
+                                  group_name=f"{group_name}.user")
+        self._grad_sync = {"group": group_name, "backend": backend,
+                           "bucket_bytes": int(bucket_bytes),
+                           "world_size": self.world_size}
+        return True
 
     def get_host_info(self) -> Dict[str, Any]:
         return {
@@ -85,6 +109,7 @@ class TrainWorker:
                                if latest_checkpoint_path else None),
             config=config,
             dataset_shards=shards,
+            grad_sync=self._grad_sync,
         )
         ctx.run_dir = run_dir
         set_context(ctx)
@@ -226,6 +251,14 @@ class WorkerGroup:
         # make sure every worker is alive before proceeding
         ray_tpu.get([w.get_host_info.remote() for w in self.workers],
                     timeout=self.ready_timeout)
+
+    def setup_grad_sync(self, group_name: str, backend: str = "cpu",
+                        bucket_bytes: int = 32 << 20):
+        """Initialize bucketed grad sync on every worker (driver side)."""
+        ray_tpu.get([
+            w.setup_grad_sync.remote(group_name, backend, bucket_bytes)
+            for w in self.workers
+        ], timeout=300)
 
     def bootstrap_distributed(self):
         """Form the jax.distributed mesh across all workers (rank 0 hosts the

@@ -17,6 +17,19 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
+# Persistent XLA compilation cache for the TEST tier only: the suite
+# compiles the same tiny-model programs over and over in fresh processes
+# (train/pipeline/rl actors, isolated-subprocess tests, spawned workers
+# inherit this env) — cache hits turn those recompiles into loads. Scoped
+# per interpreter version under /tmp; harmless if the backend declines it.
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    import sys as _sys
+
+    _cache = f"/tmp/ray_tpu_test_jax_cache_py{_sys.version_info[0]}{_sys.version_info[1]}"
+    os.makedirs(_cache, exist_ok=True)
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 # let spawned worker processes import functions defined in test modules
 _tests_dir = os.path.dirname(os.path.abspath(__file__))
 _pp = os.environ.get("PYTHONPATH", "")

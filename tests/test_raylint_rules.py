@@ -1542,3 +1542,55 @@ def test_rsh001_suppression():
             return collective_reshard(plan, group, host, shards)  # raylint: disable=RSH001 declared broadcast: dst replicates every leaf
     """, relpath="ray_tpu/rl/sync.py", rules=["RSH001"])
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# OBS001 — PR 12 bucket-collective instruments (train.allreduce/bucket
+# metrics + per-bucket span names stay static and described)
+# ---------------------------------------------------------------------------
+
+
+def test_obs001_bucket_metrics_positive():
+    findings = lint("""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        ar = Histogram("ray_tpu.train.allreduce_seconds")
+        bk = Counter("buckets_reduced", "grad buckets reduced")
+
+        def reduce_bucket(idx):
+            with tracing.profile(f"train.bucket_allreduce.{idx}"):
+                pass
+    """, rules=["OBS001"])
+    assert rules_of(findings) == ["OBS001"] * 3
+    assert "description" in findings[0].message   # undescribed histogram
+    assert "ray_tpu_" in findings[1].message      # unprefixed counter
+    assert "static string" in findings[2].message  # per-bucket span name
+
+
+def test_obs001_bucket_metrics_negative_pr12_shapes():
+    # the shapes PR 12 actually ships: described ray_tpu.train.* metrics,
+    # static span names with the bucket index as a TAG (bounded
+    # cardinality lives in attributes, not the name)
+    findings = lint("""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        ar = Histogram("ray_tpu.train.allreduce_seconds",
+                       "wall time of one grad-bucket collective",
+                       boundaries=[0.001, 0.01, 0.1])
+        bb = Histogram("ray_tpu.train.bucket_bytes",
+                       "payload bytes of one grad bucket",
+                       boundaries=[1024, 1 << 20])
+        n = Counter("ray_tpu.train.buckets_reduced",
+                    "grad buckets reduced through the async path")
+
+        def reduce_bucket(idx, nbytes):
+            with tracing.profile("train.bucket_allreduce", category="train",
+                                 bucket=idx, nbytes=nbytes):
+                pass
+            with tracing.profile("pipe.bucket_apply", category="pipe",
+                                 bucket=idx):
+                pass
+    """, rules=["OBS001"])
+    assert findings == []

@@ -1,0 +1,24 @@
+"""Tier-1 smoke: one bucketed + sharded-update train step on the CPU mesh.
+
+Runs ``tools.bench_train.bench_step_flavors`` (the same callable the
+overlap microbench CLI uses) under ``JAX_PLATFORMS=cpu`` so the sharded
+step, the split programs, and the traced bucketed pipeline cannot rot
+between BENCH rounds — if any flavor stops compiling or diverges, this
+fails in CI rather than in the next bench round on hardware.
+"""
+
+import numpy as np
+
+
+def test_bench_train_step_flavors_smoke():
+    from tools.bench_train import bench_step_flavors
+
+    out = bench_step_flavors(bucket_bytes=64 << 10, steps=1, warmup=0)
+    assert out["n_devices"] == 8  # conftest's forced CPU mesh
+    for key in ("fused_step_us", "fused_sharded_step_us",
+                "split_sharded_step_us", "traced_sharded_step_us"):
+        assert key in out and np.isfinite(out[key]) and out[key] > 0
+    assert out["opt_state_bytes_per_replica"] < out["opt_state_bytes_total"] / 4
+    plan = out["bucket_plan"]
+    assert plan["num_buckets"] >= 1
+    assert plan["total_bytes"] > 0
