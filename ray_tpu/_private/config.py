@@ -136,8 +136,16 @@ _d("zygote_fork_timeout_s", 20.0)
 # one-shot prestart above still applies)
 _d("worker_pool_warm_target", 2)
 # multi-grant leases: one RequestWorkerLease can return up to this many
-# grants when the owner asks (count=N) and warm workers are available
+# grants when the owner asks (count=N); warm workers are granted first and
+# the remainder is forked from the zygote (spawn-backed top-up)
 _d("lease_max_grants", 8)
+# renv-keyed warm pool: also keep this many warm workers forked for the
+# most-recently-leased non-default runtime env (0 disables; hot renvs then
+# always pay a fork on grant)
+_d("worker_pool_warm_target_renv", 2)
+# GCS resource_view coalescing tick: availability changes are folded into
+# one batched publish per tick (membership changes still flush immediately)
+_d("gcs_resource_view_tick_s", 0.1)
 _d("max_lineage_bytes", 64 * 1024**2)
 # ownership-based distributed refcounting (reference: reference_counter.h:44)
 _d("distributed_refcounting", 1)

@@ -143,7 +143,9 @@ class _LeasePool:
     single ``PushTaskBatch`` RPC, amortizing the per-call framing/event-loop
     overhead that otherwise dominates small-task throughput."""
 
-    BATCH = 16
+    BATCH = 16  # base batch per push round trip (also the lease-count unit)
+    BATCH_MAX = 128  # queue-depth-scaled ceiling (see _batch_cap)
+    BATCH_MAX_BYTES = 1 << 20  # serialized-arg byte bound per push
 
     def __init__(self, core: "CoreWorker", key, opts, resources):
         from collections import deque
@@ -165,12 +167,38 @@ class _LeasePool:
         # this, N concurrent pushers each request the full batch for the
         # same queue and the raylet over-grants N-fold
         self.requesting = 0
+        # EWMA of the push round trip, feeding the micro-batch hold-off
+        # (see _pusher): long RTTs earn proportionally longer accumulation.
+        # rtt_measured gates the short-task regime below: until a round
+        # trip has actually completed, the pool could be running hour-long
+        # tasks and must keep the conservative share division.
+        self.rtt_ewma = 0.005
+        self.rtt_measured = False
+        # burst detector: consecutive submits with sub-300µs inter-arrival
+        # (a `.remote()` loop runs at ~10µs-100µs/call; chains and trickle
+        # traffic arrive at >= one push RTT apart and never trip this)
+        self._burst_n = 0
+        self._last_submit = 0.0
 
     def submit(self, record: dict):
-        record.setdefault("_done", asyncio.Event())
+        now = time.monotonic()
+        if now - self._last_submit < 0.0003:
+            self._burst_n += 1
+        else:
+            self._burst_n = 0
+        self._last_submit = now
         self.pending.append(record)
         self._work.set()
         self._ensure_pushers()
+
+    def _batch_cap(self) -> int:
+        """Queue-depth-adaptive batch size (same spirit as plan_buckets:
+        amortize per-item overhead into per-batch overhead up to a bound):
+        deep backlogs earn bigger batches so a 20k-task burst pays ~1/128th
+        of the per-push framing, while shallow queues keep small batches —
+        one push can't hold the lease hostage. The byte bound is applied by
+        the pusher while it pops (args ride the push payload)."""
+        return min(self.BATCH_MAX, max(self.BATCH, len(self.pending) // 8))
 
     def _ensure_pushers(self):
         cap = RAY_CONFIG.max_pending_lease_requests
@@ -226,11 +254,45 @@ class _LeasePool:
                     # left, or long tasks serialize onto the first lease.
                     # On a saturated cluster this degrades to small batches,
                     # where push round trips are not the bottleneck anyway.
+                    if len(self.pending) < self.BATCH and self._burst_n >= 4:
+                        # Nagle-style micro-batching: the submit stream is
+                        # BURSTING (consecutive sub-300µs inter-arrivals —
+                        # a `.remote()` loop), so new arrivals can afford
+                        # to accumulate for a fraction of the push round
+                        # trip instead of paying a whole push per task.
+                        # Chains and trickle traffic arrive >= one RTT
+                        # apart, never trip the detector, and keep their
+                        # first-push latency untouched.
+                        deadline = time.monotonic() + min(
+                            0.008, max(0.001, self.rtt_ewma / 4))
+                        last = len(self.pending)
+                        while last < self.BATCH \
+                                and time.monotonic() < deadline:
+                            await asyncio.sleep(0.001)
+                            if len(self.pending) == last:
+                                break  # burst ended; stop paying latency
+                            last = len(self.pending)
+                        # fall through: an empty queue parks below as usual
                     share = -(-len(self.pending) // max(1, self.pushers))
-                    take = max(1, min(self.BATCH, share))
+                    if self.rtt_measured and self.rtt_ewma < 0.1:
+                        # short-task regime (sub-100ms push round trips):
+                        # batch aggressively instead of dividing the queue
+                        # across every live pusher — under a burst dozens
+                        # of pushers are mid-flight, the share pins at 1-2
+                        # and per-push framing dominates the owner loop.
+                        # Long-task pools keep the share division so
+                        # staggered arrivals don't serialize onto one
+                        # lease (there the round trip IS the task).
+                        share = len(self.pending)
+                    take = max(1, min(self._batch_cap(), share))
                     batch = []
+                    nbytes = 0
                     while self.pending and len(batch) < take:
-                        batch.append(self.pending.popleft())
+                        r = self.pending.popleft()
+                        batch.append(r)
+                        nbytes += r.get("bytes", 0)
+                        if nbytes >= self.BATCH_MAX_BYTES:
+                            break
                     if not batch:
                         self._work.clear()
                         if self.pending:  # a submit raced the clear
@@ -323,12 +385,36 @@ class _LeasePool:
                     attempt=record["epoch"],
                     worker=lease["worker_address"],
                     job_id=record.get("_job_hex", ""))
-        payload = wire.dumps({"specs": [r["spec"] for r in batch]})
+        # template-aware framing: records from the submit warm path carry a
+        # preserialized spec template blob — ship each distinct template
+        # ONCE per batch plus (task_id, args, attempt) triples, instead of
+        # re-encoding every full spec (options, selectors, runtime env)
+        templates: List[bytes] = []
+        tmpl_index: Dict[int, int] = {}
+        items: List[tuple] = []
+        for r in batch:
+            tmpl = r.get("_tmpl")
+            if tmpl is None:
+                items.append(("s", r["spec"]))
+            else:
+                ix = tmpl_index.get(id(tmpl))
+                if ix is None:
+                    ix = tmpl_index[id(tmpl)] = len(templates)
+                    templates.append(tmpl)
+                spec = r["spec"]
+                items.append(("t", ix, spec.task_id, spec.args_blob,
+                              spec.attempt))
+        payload = wire.dumps({"templates": templates, "items": items})
+        stats = core._submit_stats
+        stats["push_batches"] += 1
+        stats["push_tasks"] += len(batch)
+        push_t0 = time.perf_counter()
         try:
             reply = wire.loads(await core._worker_client(
                 lease["worker_address"]).call(
                     "PushTaskBatch", payload, timeout=86400.0, retries=0))
         except (RpcError, asyncio.TimeoutError, OSError) as e:
+            stats["push_s"] += time.perf_counter() - push_t0
             # requeue retriable records FIRST: the OOM probe below can take
             # seconds against a dead raylet and is only needed when some
             # record is about to surface a terminal error
@@ -364,6 +450,10 @@ class _LeasePool:
                             f"worker died running {record['name']} "
                             f"(after {record['attempts']} attempts): {e}", ""))
             return False
+        rtt = time.perf_counter() - push_t0
+        stats["push_s"] += rtt
+        self.rtt_ewma = 0.8 * self.rtt_ewma + 0.2 * rtt
+        self.rtt_measured = True
         for record, res in zip(batch, reply["results"]):
             if res["status"] == "ok":
                 core._process_reply_refs(res, lease["worker_address"])
@@ -682,6 +772,10 @@ class CoreWorker:
         # owner state
         self.memory_store: Dict[ObjectID, Any] = {}
         self._result_futures: Dict[ObjectID, asyncio.Future] = {}
+        # return ids whose producing task is in flight but whose result
+        # future has not been demanded yet: futures are allocated lazily on
+        # the first get/await (submit only marks pendency — a dict insert)
+        self._pending_returns: Dict[ObjectID, bool] = {}
         self._in_store: Dict[ObjectID, bool] = {}
         self._tasks: Dict[TaskID, dict] = {}  # lineage / retry records
         self._actor_inflight: Dict[TaskID, dict] = {}  # for cancel()
@@ -689,6 +783,14 @@ class CoreWorker:
         # ownership refcounting (reference: reference_counter.h:44)
         self.ref_counter = ReferenceCounter(lambda: self.address)
         self._free_pending: set = set()
+        # batched zero-ref intake: __del__-side ref drops append here (a
+        # GIL-atomic deque op) and the 0.2s refcount sweep drains it —
+        # replacing a per-object call_soon_threadsafe self-pipe write,
+        # which at 20k frees/s was a visible slice of the io loop
+        from collections import deque as _fdeque
+
+        self._free_zero_q: "Any" = _fdeque()
+        self._free_grace_q: "Any" = _fdeque()  # (deadline, oid) FIFO
         # owner-initiated borrow tracking (reference: WaitForRefRemoved in
         # reference_counter.cc): per borrower address, {oid: generation}
         # being watched by a long-poll loop — the generation fences stale
@@ -702,6 +804,23 @@ class CoreWorker:
         self._actor_name_cache: Dict[ActorID, tuple] = {}
         self._pushed_functions: set = set()
         self._fn_key_cache: Dict[int, tuple] = {}
+        # submit fast path (reference: the owner hot loop in
+        # normal_task_submitter.cc): per-RemoteFunction cache of the
+        # preserialized TaskSpec template (everything invariant across
+        # `.remote()` calls of one function+options pair), the resolved
+        # function key / prepared options, and the lease pool — so a warm
+        # submit fills only task_id + args instead of re-framing the whole
+        # spec through wire.dumps. Keyed by id() WITH a strong ref (slot 0)
+        # so a recycled id can never alias a different function.
+        self._spec_template_cache: Dict[int, tuple] = {}
+        # per-submit cost accounting (drives the STRESS_r* µs breakdown and
+        # the fast-path regression tests); plain counters, no locks — all
+        # writers hold the GIL per op and precision loss is acceptable
+        self._submit_stats: Dict[str, float] = {
+            "count": 0, "serialize_s": 0.0, "events_s": 0.0,
+            "kickoff_s": 0.0, "push_s": 0.0, "push_tasks": 0,
+            "push_batches": 0, "spec_frames": 0, "kickoff_wakeups": 0,
+            "fast_path": 0}
         self._put_index = 0
         self._spread_hint = 0
         self.segments = SegmentCache()
@@ -748,25 +867,52 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def _queue_kickoff(self, fn):
-        """Enqueue a submit-side continuation; wakes the loop only when the
-        queue was idle (benign double-schedule race: drains are no-ops on
-        an empty queue)."""
+        """Enqueue a submit-side continuation; ONE loop wakeup per burst of
+        `.remote()` calls (call_soon_threadsafe writes the loop's self-pipe,
+        ~50us each on a small host — per-task it would dominate the submit
+        hot loop)."""
         self._kickoff_q.append(fn)
         if not self._kickoff_scheduled:
             self._kickoff_scheduled = True
+            self._submit_stats["kickoff_wakeups"] += 1
             self.loop.call_soon_threadsafe(self._drain_kickoffs)
 
     def _drain_kickoffs(self):
-        self._kickoff_scheduled = False
+        """Drain the whole queue, THEN clear the scheduled flag: submits
+        landing mid-drain ride this drain instead of paying another
+        self-pipe write. The post-clear recheck closes the race where a
+        producer appended between our empty pop and the flag clear (it saw
+        the flag still set and skipped its wakeup)."""
         while True:
             try:
                 fn = self._kickoff_q.popleft()
             except IndexError:
+                self._kickoff_scheduled = False
+                if self._kickoff_q:
+                    self._kickoff_scheduled = True
+                    self.loop.call_soon(self._drain_kickoffs)
                 return
             try:
                 fn()
             except Exception:
                 logger.exception("task kickoff failed")
+
+    def _return_pending(self, oid: ObjectID) -> bool:
+        """Is a locally-owned task still producing this return id?"""
+        if oid in self._pending_returns:
+            return True
+        fut = self._result_futures.get(oid)
+        return fut is not None and not fut.done()
+
+    def _ensure_result_future(self, oid: ObjectID):
+        """Result future on demand (loop thread only): submit marks
+        pendency in ``_pending_returns`` — a dict insert — and the FIRST
+        get/await allocates the future. Tasks whose results are consumed
+        via wait/stream/store paths never pay the per-submit allocation."""
+        fut = self._result_futures.get(oid)
+        if fut is None and oid in self._pending_returns:
+            fut = self._result_futures[oid] = self.loop.create_future()
+        return fut
 
     def _start_loop(self):
         if self._loop_thread is not None or not self._owned_loop:
@@ -863,6 +1009,12 @@ class CoreWorker:
         while not self._shutdown:
             try:
                 self.ref_counter.flush_deletes()
+                while self._free_zero_q:
+                    self._schedule_free(self._free_zero_q.popleft())
+                now = time.monotonic()
+                while self._free_grace_q and self._free_grace_q[0][0] <= now:
+                    _, oid = self._free_grace_q.popleft()
+                    spawn(self._free_owned(oid), what="owned-object free")
                 if time.monotonic() - last_reassert > 30.0:
                     last_reassert = time.monotonic()
                     # fire-and-track: an unreachable owner (10s timeout
@@ -1140,7 +1292,7 @@ class CoreWorker:
             if oid in self.memory_store:
                 return self.memory_store[oid]
             # 2. a pending local task will produce it
-            fut = self._result_futures.get(oid)
+            fut = self._ensure_result_future(oid)
             if fut is not None and not fut.done():
                 timeout = deadline - time.monotonic()
                 if timeout <= 0:
@@ -1290,7 +1442,7 @@ class CoreWorker:
                     if oid in self.memory_store or self._in_store.get(oid):
                         ready.append(r)
                         continue
-                    fut = self._result_futures.get(oid)
+                    fut = self._ensure_result_future(oid)
                     if fut is None:
                         store_pending.append(r)
                     elif fut.done():
@@ -1299,7 +1451,11 @@ class CoreWorker:
                         fut_pending.append(fut)
                 if len(ready) >= num_returns or time.monotonic() >= deadline:
                     ready = ready[:num_returns]
-                    return ready, [r for r in refs if r not in ready]
+                    # identity filter: ready elements ARE elements of refs,
+                    # so id() membership avoids the O(n*m) ObjectRef __eq__
+                    # scan (visible on 1000-ref wait windows)
+                    ready_ids = {id(r) for r in ready}
+                    return ready, [r for r in refs if id(r) not in ready_ids]
                 chunk = max(0.05, min(10.0, deadline - time.monotonic()))
                 waiters = []
                 if fut_pending:
@@ -1390,25 +1546,25 @@ class CoreWorker:
     # ------------------------------------------------------------------
 
     def _on_owned_zero(self, oid: bytes):
-        """All local refs/pins/borrowers of an owned object released."""
+        """All local refs/pins/borrowers of an owned object released.
+        Batched: the oid rides a plain deque the refcount sweep drains on
+        its next 0.2s tick — no per-object loop wakeup (the grace delay
+        below dwarfs the added sweep latency anyway)."""
         if self._shutdown:
             return
-        try:
-            self.loop.call_soon_threadsafe(self._schedule_free, oid)
-        except RuntimeError:  # raylint: disable=EXC001 loop already closed during shutdown; nothing left to free
-            pass
+        self._free_zero_q.append(oid)
 
     def _schedule_free(self, oid: bytes):
+        """Queue an owned object for freeing after the grace window (loop
+        thread only). One FIFO + the sweep loop replace a per-object
+        call_later timer: deadlines are appended in monotonic order, so
+        the sweep pops due entries from the left."""
         if not RAY_CONFIG.distributed_refcounting or oid in self._free_pending:
             return
         self._free_pending.add(oid)
-
-        def _fire():
-            if not self._shutdown:
-                spawn(self._free_owned(oid), what="owned-object free")
-
         # grace delay absorbs in-flight AddBorrower registrations
-        self.loop.call_later(RAY_CONFIG.free_grace_s, _fire)
+        self._free_grace_q.append(
+            (time.monotonic() + RAY_CONFIG.free_grace_s, oid))
 
     async def _free_owned(self, oid_bytes: bytes):
         self._free_pending.discard(oid_bytes)
@@ -1416,8 +1572,7 @@ class CoreWorker:
         if not rc.freeable(oid_bytes):
             return
         oid = ObjectID(oid_bytes)
-        fut = self._result_futures.get(oid)
-        if fut is not None and not fut.done():
+        if self._return_pending(oid):
             return  # production in flight; completion re-checks
         is_put = bool(oid.return_index() & 0x8000)
         if rc.lineage_count(oid_bytes) > 0 and is_put:
@@ -1427,6 +1582,7 @@ class CoreWorker:
         value = self.memory_store.pop(oid, None)
         await self._maybe_free_device_marker(value)
         self._result_futures.pop(oid, None)
+        self._pending_returns.pop(oid, None)
         in_store = self._in_store.pop(oid, None)
         rc.release_nested(oid_bytes)
         self._obj_locations.pop(oid_bytes, None)
@@ -1577,9 +1733,8 @@ class CoreWorker:
                 break
             if rec is record or rec.get("_recover_event") is not None:
                 continue
-            fut_pending = any(
-                (f := self._result_futures.get(rid)) is not None and not f.done()
-                for rid in rec.get("return_ids", ()))
+            fut_pending = any(self._return_pending(rid)
+                              for rid in rec.get("return_ids", ()))
             if fut_pending:
                 continue
             self._drop_record(tid, rec)  # outputs become non-reconstructable
@@ -1593,8 +1748,7 @@ class CoreWorker:
             b = rid.binary()
             if not rc.freeable(b) or rc.lineage_count(b) > 0:
                 return
-            fut = self._result_futures.get(rid)
-            if fut is not None and not fut.done():
+            if self._return_pending(rid):
                 return
         self._drop_record(task_id, rec)
 
@@ -1668,8 +1822,10 @@ class CoreWorker:
                 self._in_store.pop(rid, None)
                 self.memory_store.pop(rid, None)
                 old = self._result_futures.get(rid)
-                if old is None or old.done():
-                    self._result_futures[rid] = self.loop.create_future()
+                if old is not None and old.done():
+                    self._result_futures.pop(rid, None)
+                # re-mark pendency; a waiter re-allocates the future lazily
+                self._pending_returns[rid] = True
             rec["attempts"] = 0  # fresh retry budget for the recovery run
             for ob, ow in rec.get("arg_refs", ()):
                 self.ref_counter.pin(ob, ow)
@@ -1689,17 +1845,39 @@ class CoreWorker:
         thread; the drive coroutine is kicked off fire-and-forget so batched
         ``.remote()`` loops pipeline instead of paying a cross-thread round
         trip per call (reference: the owner-side submit path is the tasks/s
-        hot loop, normal_task_submitter.cc)."""
+        hot loop, normal_task_submitter.cc).
+
+        Warm path (template cached for this RemoteFunction, tracing off):
+        the spec reuses the resolved function key + prepared options and
+        carries a preserialized template blob, so the per-submit work is
+        arg serialization + bookkeeping inserts — the spec is never
+        re-framed through ``wire.dumps``, no future/coroutine is allocated,
+        and the batch pusher ships ``(task_id, args, attempt)`` against the
+        template."""
+        from ray_tpu.util import tracing
+
+        stats = self._submit_stats
+        stats["count"] += 1
         task_id = TaskID.of(self.job_id)
         streaming = opts.num_returns == "streaming"
         nret = 0 if streaming else opts.num_returns
         refs = [ObjectRef(ObjectID.for_task_return(task_id, i), self.address)
                 for i in range(nret)]
+        t0 = time.perf_counter()
         args_blob, arg_refs = self._pack_args(args, kwargs)
+        stats["serialize_s"] += time.perf_counter() - t0
+        cached = self._spec_template_cache.get(id(remote_fn))
+        fast = (cached is not None and cached[0] is remote_fn
+                and not tracing.enabled())
+        if fast:
+            _rf, fn_key, popts, pool, tmpl_blob = cached
+            opts = popts
+        else:
+            fn_key, pool, tmpl_blob = "", None, None
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
-            function_key="",  # filled by _drive_task_prepared
+            function_key=fn_key,  # empty -> filled by _drive_task_prepared
             args_blob=args_blob,
             num_returns=-1 if streaming else nret,
             options=opts,
@@ -1711,37 +1889,84 @@ class CoreWorker:
                   "arg_refs": arg_refs, "bytes": len(args_blob) + 512,
                   "name": remote_fn.function_name,
                   "_submit_ts": time.time()}
-        self._stamp_trace(spec, record["name"])
+        if tmpl_blob is not None:
+            record["_tmpl"] = tmpl_blob
+        if not fast:
+            self._stamp_trace(spec, record["name"])
         if task_events.enabled():
             record["_job_hex"] = jh = self.job_id.hex()
-            task_events.record(task_id.hex(), task_events.SUBMITTED,
-                               name=record["name"], job_id=jh,
-                               arg_bytes=len(args_blob),
-                               span_id=_task_span_id(spec),
-                               parent_span=self._submitter_span())
+            t1 = time.perf_counter()
+            task_events.record_submitted(
+                task_id.hex(), record["_submit_ts"], record["name"], jh,
+                len(args_blob), _task_span_id(spec), self._submitter_span())
+            stats["events_s"] += time.perf_counter() - t1
         for oid, owner in arg_refs:
             self.ref_counter.pin(oid, owner)
         record["_pinned"] = True
         for ref in refs:
-            # created off-loop so a get() racing the kickoff finds them
-            self._result_futures[ref.id] = asyncio.Future(loop=self.loop)
+            # marked off-loop so a get() racing the kickoff sees pendency;
+            # the future itself is allocated lazily on first get/await
+            self._pending_returns[ref.id] = True
         if streaming:
             # per-stream state the executor's StreamTaskReturn RPCs fill
             self._streams[task_id.binary()] = {
                 "produced": 0, "total": None, "error": None,
                 "event": asyncio.Event()}
+        t2 = time.perf_counter()
+        if fast:
+            stats["fast_path"] += 1
 
-        def _kickoff():
-            self._register_lineage(task_id, record)
-            spawn(self._drive_task_prepared(remote_fn, record),
-                  what="task drive")
+            def _kickoff():
+                self._register_lineage(task_id, record)
+                if record.get("_cancelled") \
+                        or self._has_pending_local_deps(record):
+                    spawn(self._drive_task(record, wait=False),
+                          what="task drive")
+                    return
+                if task_events.enabled():
+                    task_events.record(
+                        task_id.hex(), task_events.LEASE_REQUESTED,
+                        attempt=spec.attempt,
+                        job_id=record.get("_job_hex", ""))
+                pool.submit(record)
+        else:
+            def _kickoff():
+                self._register_lineage(task_id, record)
+                spawn(self._drive_task_prepared(remote_fn, record),
+                      what="task drive")
 
         self._queue_kickoff(_kickoff)
+        stats["kickoff_s"] += time.perf_counter() - t2
         if streaming:
             from ray_tpu.object_ref import ObjectRefGenerator
 
             return ObjectRefGenerator(self, task_id, self.address)
         return refs[0] if nret == 1 else refs
+
+    def _has_pending_local_deps(self, record: dict) -> bool:
+        """Sync form of _resolve_dependencies' wait condition: does any
+        locally-owned ref arg still have its producer in flight?"""
+        for oid_b, owner in record.get("arg_refs", ()):
+            if (not owner or owner == self.address) \
+                    and self._return_pending(ObjectID(oid_b)):
+                return True
+        return False
+
+    def submit_stats(self) -> dict:
+        """Per-submit cost breakdown (µs, amortized over all submits so
+        far): the serialize/events/kickoff legs are caller-thread wall
+        time; push_rtt is the PushTaskBatch round trip INCLUDING remote
+        execution, amortized per task (round trips overlap across pushers,
+        so it is an upper bound on the owner-side push cost)."""
+        s = dict(self._submit_stats)
+        n = max(1, s["count"])
+        s["per_submit_us"] = {
+            "serialize": round(s["serialize_s"] / n * 1e6, 2),
+            "events": round(s["events_s"] / n * 1e6, 2),
+            "kickoff": round(s["kickoff_s"] / n * 1e6, 2),
+            "push_rtt": round(s["push_s"] / max(1, s["push_tasks"]) * 1e6, 2),
+        }
+        return s
 
     def _submitter_span(self) -> str:
         """The submitter's active span id (the enclosing task's execution
@@ -1789,10 +2014,42 @@ class CoreWorker:
                 f"submission failed for {record['name']}: {e}",
                 traceback.format_exc()))
             return
+        self._cache_spec_template(remote_fn, spec)
         # fire-and-forget: completion flows through the result futures; only
         # recovery re-execution needs to await the record (saves a coroutine
         # suspension+wake per task on the submit hot path)
         await self._drive_task(record, wait=False)
+
+    def _cache_spec_template(self, remote_fn, spec: TaskSpec):
+        """Frame the invariant part of this (function, options) pair's spec
+        ONCE: later submits reuse the blob (see submit_task's warm path)
+        and the pusher ships only (task_id, args_blob, attempt) against it.
+        The prepared options (runtime env uploaded, function key resolved)
+        and the lease pool ride along so the warm path does no awaits."""
+        import copy as _copy
+
+        cached = self._spec_template_cache.get(id(remote_fn))
+        if cached is not None and cached[0] is remote_fn:
+            return
+        if len(self._spec_template_cache) >= 1024:
+            # bound the cache: `f.options(...).remote()` mints a NEW
+            # RemoteFunction per call, so without eviction a submit loop
+            # over one-shot options objects grows this (and the strong
+            # refs in slot 0) without limit. A full clear is fine — live
+            # functions re-frame once each (counted in spec_frames).
+            self._spec_template_cache.clear()
+        tmpl = _copy.copy(spec)
+        tmpl.task_id = TaskID.nil()
+        tmpl.args_blob = b""
+        tmpl.attempt = 0
+        tmpl.trace_id = ""
+        tmpl.parent_span_id = ""
+        blob = wire.dumps(tmpl)
+        self._submit_stats["spec_frames"] += 1
+        pool = self._lease_pool_for(spec.options,
+                                    spec.options.required_resources())
+        self._spec_template_cache[id(remote_fn)] = (
+            remote_fn, spec.function_key, spec.options, pool, blob)
 
     def _pack_args(self, args, kwargs):
         # inline small owned values so the executor need not call back
@@ -1821,7 +2078,7 @@ class CoreWorker:
         for oid_b, owner in record.get("arg_refs", ()):
             if owner and owner != self.address:
                 continue  # foreign-owned: the executor resolves via that owner
-            fut = self._result_futures.get(ObjectID(oid_b))
+            fut = self._ensure_result_future(ObjectID(oid_b))
             if fut is not None and not fut.done():
                 await asyncio.shield(fut)
 
@@ -1843,7 +2100,10 @@ class CoreWorker:
                                attempt=spec.attempt,
                                job_id=record.get("_job_hex", ""))
         pool = self._lease_pool_for(opts, opts.required_resources())
-        record["_done"] = asyncio.Event()
+        if wait:
+            # only recovery re-execution blocks on the record; the normal
+            # path skips the per-task Event allocation entirely
+            record["_done"] = asyncio.Event()
         pool.submit(record)
         if wait:
             await record["_done"].wait()
@@ -1902,6 +2162,7 @@ class CoreWorker:
                 self.memory_store[oid] = deserialize(inband, buffers)
             else:  # stored in the distributed object store
                 self._in_store[oid] = True
+            self._pending_returns.pop(oid, None)
             fut = self._result_futures.get(oid)
             if fut is not None and not fut.done():
                 fut.set_result(True)
@@ -1926,8 +2187,10 @@ class CoreWorker:
         for oid in record["return_ids"]:
             if streaming and (oid in self.memory_store
                               or self._in_store.get(oid)):
+                self._pending_returns.pop(oid, None)
                 continue  # already-yielded items stay readable
             self.memory_store[oid] = err
+            self._pending_returns.pop(oid, None)
             fut = self._result_futures.get(oid)
             if fut is not None and not fut.done():
                 fut.set_result(True)
@@ -1935,6 +2198,13 @@ class CoreWorker:
         done = record.get("_done")
         if done is not None:
             done.set()
+        # re-schedule frees that _free_owned deferred while production was
+        # in flight (same re-check _complete_ok does): without it an error
+        # object whose refs were all dropped mid-flight stays in
+        # memory_store forever
+        for oid in record["return_ids"]:
+            if self.ref_counter.freeable(oid.binary()):
+                self._schedule_free(oid.binary())
 
     # -- leases --
 
@@ -2166,16 +2436,15 @@ class CoreWorker:
         self._stamp_trace(spec, record["name"])
         if task_events.enabled():
             record["_job_hex"] = jh = self.job_id.hex()
-            task_events.record(task_id.hex(), task_events.SUBMITTED,
-                               name=record["name"], job_id=jh,
-                               arg_bytes=len(args_blob),
-                               span_id=_task_span_id(spec),
-                               parent_span=self._submitter_span())
+            task_events.record_submitted(
+                task_id.hex(), record["_submit_ts"], record["name"], jh,
+                len(args_blob), _task_span_id(spec), self._submitter_span())
         for oid, owner in arg_refs:
             self.ref_counter.pin(oid, owner)
         record["_pinned"] = True
         for ref in refs:
-            self._result_futures[ref.id] = asyncio.Future(loop=self.loop)
+            # lazy result futures, same as submit_task
+            self._pending_returns[ref.id] = True
 
         def _kickoff():
             view = self._actor_view(handle.actor_id)
@@ -2504,7 +2773,27 @@ class CoreWorker:
             req = wire.loads(payload)
             return await self._handle_push_task(req["spec"])
         if method == "PushTaskBatch":
+            import copy as _copy
+
             req = wire.loads(payload)
+            if "items" in req:
+                # template framing (owner warm path): decode each distinct
+                # spec template once, then stamp per-task fields onto
+                # shallow copies. The options object is shared across the
+                # batch — execution only reads it.
+                tmpls = [wire.loads(b) for b in req.get("templates", ())]
+                specs = []
+                for it in req["items"]:
+                    if it[0] == "s":
+                        specs.append(it[1])
+                    else:
+                        spec = _copy.copy(tmpls[it[1]])
+                        spec.task_id = it[2]
+                        spec.args_blob = it[3]
+                        spec.attempt = it[4]
+                        specs.append(spec)
+            else:
+                specs = req["specs"]
             results = []
             run: List[TaskSpec] = []  # consecutive plain tasks, fused
 
@@ -2513,7 +2802,7 @@ class CoreWorker:
                     results.extend(await self._exec_normal_batch(run))
                     run.clear()
 
-            for spec in req["specs"]:
+            for spec in specs:
                 if spec.actor_id is None and not spec.is_actor_creation \
                         and spec.num_returns != -1:
                     run.append(spec)
@@ -2756,7 +3045,7 @@ class CoreWorker:
                                      "blob": pack_blob(*serialize(value))})
             if self._in_store.get(oid):
                 return wire.dumps({"status": "in_store"})
-            fut = self._result_futures.get(oid)
+            fut = self._ensure_result_future(oid)
             if fut is not None and not fut.done() and time.monotonic() < deadline:
                 try:
                     await asyncio.wait_for(asyncio.shield(fut),

@@ -792,8 +792,14 @@ class GcsServer:
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.node_available: Dict[NodeID, Dict[str, float]] = {}
         # last availability broadcast per node (delta suppression for the
-        # resource_view syncer stream; reference: ray_syncer.h:89)
+        # resource_view syncer stream; reference: ray_syncer.h:89), plus
+        # the per-tick coalescing set: availability changes mark a node
+        # dirty and ONE batched resource_view publish per GCS tick carries
+        # the latest view of every dirty node — a 20k-task burst flapping
+        # availability 50×/s per node costs one publish per tick, not one
+        # per change (reference: the ray_syncer broadcast interval)
         self._last_view_pub: Dict[NodeID, Dict[str, float]] = {}
+        self._view_dirty: Set[NodeID] = set()
         self.node_last_seen: Dict[NodeID, float] = {}
         self.node_clients: Dict[NodeID, RetryingRpcClient] = {}
         self.kv: Dict[Tuple[str, str], bytes] = {}
@@ -913,6 +919,8 @@ class GcsServer:
         self.task_manager._ensure_thread()
         self._background.append(spawn(self._metrics_history_loop(),
                                       what="gcs metrics-history sampler"))
+        self._background.append(spawn(self._resource_view_flush_loop(),
+                                      what="gcs resource-view flusher"))
         self._background.append(spawn(self._health_monitor_loop(),
                                       what="gcs health-monitor scanner"))
         # resume interrupted scheduling work from replayed init data
@@ -980,7 +988,10 @@ class GcsServer:
         logger.info("node %s registered: %s labels=%s", info.node_id.hex()[:8],
                     info.total_resources, info.labels)
         self._publish("nodes", {"event": "added", "node": info.to_dict()})
-        self._publish("resource_view", self._view_entry(info.node_id))
+        # membership changes flush immediately (spillback views must learn
+        # about a new peer now); coalescing is for availability flapping
+        self._view_dirty.add(info.node_id)
+        self._flush_resource_views()
         self._record_event("node", "INFO", "node registered",
                            node_id=info.node_id.hex(),
                            resources=dict(info.total_resources))
@@ -998,16 +1009,54 @@ class GcsServer:
         # syncer: broadcast availability DELTAS to subscribed raylets so
         # their local schedulers can spill leases peer-to-peer without a
         # per-lease GCS round trip (reference: ray_syncer.h:89 resource
-        # views over bidi streams; here piggybacked on 1 Hz heartbeats)
+        # views over bidi streams). Changes only mark the node dirty here;
+        # the tick loop folds all dirty nodes into ONE batched publish
+        # (delta suppression re-checked at flush: a value that flapped
+        # back to the published view inside the tick publishes nothing)
         if self._last_view_pub.get(node_id) != req["available"]:
-            self._last_view_pub[node_id] = dict(req["available"])
-            self._publish("resource_view", self._view_entry(node_id))
+            self._view_dirty.add(node_id)
         # parked lease shapes feed the autoscaler's demand view (the
         # two-level path no longer touches PickNode for schedulable work)
         for shape in req.get("pending_shapes", ()):
             self._record_demand(shape["resources"], shape.get("selector", {}),
                                 shape.get("waiter_id", ""))
         return {"status": "ok"}
+
+    def _flush_resource_views(self):
+        """Fold every dirty node into one batched ``resource_view`` publish
+        carrying its LATEST view (subscribers apply entries idempotently,
+        so intermediate states are safely elided). Delta suppression runs
+        here, not at mark time: only views that still differ from the last
+        broadcast actually ship."""
+        if not self._view_dirty:
+            return
+        views = []
+        for node_id in list(self._view_dirty):
+            self._view_dirty.discard(node_id)
+            info = self.nodes.get(node_id)
+            if info is None:
+                self._last_view_pub.pop(node_id, None)
+                continue
+            entry = self._view_entry(node_id)
+            if not info.alive:
+                self._last_view_pub.pop(node_id, None)
+                views.append(entry)
+                continue
+            if self._last_view_pub.get(node_id) == entry["available"]:
+                continue
+            self._last_view_pub[node_id] = dict(entry["available"])
+            views.append(entry)
+        if views:
+            self._publish("resource_view", {"views": views})
+
+    async def _resource_view_flush_loop(self):
+        tick = RAY_CONFIG.gcs_resource_view_tick_s
+        while True:
+            await asyncio.sleep(tick)
+            try:
+                self._flush_resource_views()
+            except Exception:
+                logger.exception("resource-view flush failed")
 
     def _view_entry(self, node_id: NodeID) -> dict:
         info = self.nodes[node_id]
@@ -1062,7 +1111,9 @@ class GcsServer:
         self._persist_node(info)
         logger.warning("node %s dead: %s", node_id.hex()[:8], reason)
         self._publish("nodes", {"event": "removed", "node_id": node_id.hex(), "reason": reason})
-        self._publish("resource_view", self._view_entry(node_id))
+        # death flushes immediately: spillback must stop targeting it now
+        self._view_dirty.add(node_id)
+        self._flush_resource_views()
         self._record_event("node", "ERROR", f"node dead: {reason}",
                            node_id=node_id.hex())
         # drop object locations on that node; keep the committed-attempt

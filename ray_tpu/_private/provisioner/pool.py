@@ -141,6 +141,20 @@ class WorkerProvisioner:
             "hits": 0, "misses": 0, "forks": 0, "cold_spawns": 0,
             "zygote_restarts": 0, "fork_failures": 0,
         }
+        # renv-keyed warm pool: the most-recently-leased non-default
+        # runtime env (hash, env dict). The replenish loop keeps warm
+        # workers forked for it too, so a hot non-default env stops
+        # bypassing the pool (every grant was a fork: STRESS_r06 showed
+        # 113 misses vs 72 hits on the hot node for exactly this reason).
+        self.hot_renv: Optional[tuple] = None
+
+    def note_renv(self, renv_hash: str, renv: Optional[dict]):
+        """Record the most-recently-requested runtime env for replenish
+        keying. Only zygote-forkable envs qualify (pip envs — including
+        uv, which normalize() folds into the "pip" key — run a different
+        interpreter and can never come from the pool)."""
+        if renv_hash and renv and "pip" not in renv:
+            self.hot_renv = (renv_hash, dict(renv))
 
     # -- zygote lifecycle ----------------------------------------------
 
@@ -365,33 +379,68 @@ class WorkerProvisioner:
     # -- warm pool replenishment ----------------------------------------
 
     async def replenish_loop(self):
-        """Keep ``worker_pool_warm_target`` default-env workers forked AND
+        """Keep ``worker_pool_warm_target`` default-env workers — PLUS
+        ``worker_pool_warm_target_renv`` workers keyed to the most-recently
+        -leased non-default runtime env (``note_renv``) — forked AND
         registered so lease grants adopt instead of spawning. Zygote-only:
         when the zygote is down, topping up via cold Popen would burn the
         very CPU the pending leases need."""
         target = max(0, int(RAY_CONFIG.worker_pool_warm_target))
-        if target == 0 or not self.enabled:
+        renv_target = max(0, int(RAY_CONFIG.worker_pool_warm_target_renv))
+        if (target == 0 and renv_target == 0) or not self.enabled:
             return
         raylet = self.raylet
         while True:
             await asyncio.sleep(0.25)
             try:
-                if not self.zygote_alive:
-                    continue
-                warm = sum(1 for w in raylet.idle_workers
-                           if w.job_hex is None and not w.renv_hash)
-                if warm >= target \
+                # evict warm workers keyed to a renv that is no longer hot:
+                # without this, cycling through unique runtime envs leaves
+                # up to renv_target idle workers behind per env until
+                # max_workers_per_node starves both replenish and top-up.
+                # Runs BEFORE the zygote/capacity gate below — a node at
+                # max_workers_per_node is exactly the starved state this
+                # must dig out of, and the kill is a plain SIGKILL that
+                # needs no live zygote. Only never-leased pool forks
+                # qualify (job_hex None); removal from idle_workers is
+                # synchronous so a concurrent grant can't adopt a worker
+                # we are about to kill — the death monitor reaps the rest
+                # of the bookkeeping.
+                hot_hash = self.hot_renv[0] if self.hot_renv else ""
+                for w in list(raylet.idle_workers):
+                    if w.job_hex is None and w.renv_hash \
+                            and w.renv_hash != hot_hash:
+                        raylet.idle_workers.remove(w)
+                        try:
+                            w.proc.kill()
+                        except Exception as e:
+                            logger.debug("stale-renv evict of pid %d "
+                                         "failed: %s", w.pid, e)
+                if not self.zygote_alive \
                         or len(raylet.workers) >= RAY_CONFIG.max_workers_per_node:
                     continue
+                # one top-up per round, default env first; the hot renv
+                # bucket only replenishes once the default pool is full
+                renv, renv_hash = None, ""
+                warm = sum(1 for w in raylet.idle_workers
+                           if w.job_hex is None and not w.renv_hash)
+                if warm >= target:
+                    if self.hot_renv is None or renv_target == 0:
+                        continue
+                    renv_hash, renv = self.hot_renv
+                    warm_renv = sum(1 for w in raylet.idle_workers
+                                    if w.job_hex is None
+                                    and w.renv_hash == renv_hash)
+                    if warm_renv >= renv_target:
+                        continue
                 w = None
                 async with raylet._spawn_sem:
                     # fork directly, NEVER through the cold-Popen fallback:
                     # a refused fork (EAGAIN, zygote mid-crash) just skips
                     # this top-up round
-                    pid = await self.fork_worker(None)
+                    pid = await self.fork_worker(renv)
                     if pid is None:
                         continue
-                    w = raylet._register_forked(pid)
+                    w = raylet._register_forked(pid, renv_hash)
                     try:
                         await asyncio.wait_for(
                             w.registered, RAY_CONFIG.worker_start_timeout_s)
@@ -419,6 +468,7 @@ class WorkerProvisioner:
 
     def snapshot(self) -> dict:
         raylet = self.raylet
+        hot_hash = self.hot_renv[0] if self.hot_renv else ""
         return {
             "enabled": self.enabled,
             "zygote_alive": self.zygote_alive,
@@ -428,6 +478,11 @@ class WorkerProvisioner:
             "warm_default_env": sum(
                 1 for w in raylet.idle_workers
                 if w.job_hex is None and not w.renv_hash),
+            "hot_renv_hash": hot_hash,
+            "warm_hot_renv": sum(
+                1 for w in raylet.idle_workers
+                if w.job_hex is None and hot_hash
+                and w.renv_hash == hot_hash),
             "total_workers": len(raylet.workers),
             **self.stats,
         }

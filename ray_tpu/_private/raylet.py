@@ -227,11 +227,15 @@ class Raylet:
         if channel != "resource_view":
             return
         msg = wire.loads(payload)
-        self.cluster_view[msg["node_id"]] = {
-            "address": msg["address"], "available": msg["available"],
-            "total": msg["total"], "labels": msg["labels"],
-            "alive": msg["alive"],
-        }
+        # one publish per GCS tick carries every dirty node's latest view
+        # ("views" batch); entries are idempotent last-writer-wins, so the
+        # legacy single-entry form stays accepted
+        for m in msg["views"] if "views" in msg else (msg,):
+            self.cluster_view[m["node_id"]] = {
+                "address": m["address"], "available": m["available"],
+                "total": m["total"], "labels": m["labels"],
+                "alive": m["alive"],
+            }
 
     async def _on_gcs_reconnect(self, client):
         try:
@@ -686,6 +690,9 @@ class Raylet:
         renv = req.get("runtime_env")
         renv_hash = env_hash(renv)
         job_hex = req["job_id"].hex() if req.get("job_id") is not None else None
+        # renv-keyed warm pool: remember the hottest non-default env so the
+        # replenish loop keeps warm workers forked for it too
+        self.provisioner.note_renv(renv_hash, renv)
         deadline = time.monotonic() + RAY_CONFIG.worker_start_timeout_s
         # the two-level path sends plain leases here directly: this raylet
         # must check the label selector itself (the legacy GCS PickNode
@@ -728,9 +735,11 @@ class Raylet:
                     grant = self._record_grant(w, resources, pg, bundle_index)
                     # batched multi-grant (reference: the pipelined lease
                     # requests this amortizes in normal_task_submitter.cc):
-                    # the owner asked for up to `count` leases; extras are
-                    # granted ONLY from warm registered workers so the
-                    # reply never blocks on a spawn
+                    # the owner asked for up to `count` leases; warm
+                    # registered workers are granted instantly, then the
+                    # REMAINDER is forked from the zygote (spawn-backed
+                    # top-up) so the batch no longer caps at whatever
+                    # happened to be registered
                     extras = []
                     want = min(int(req.get("count", 1)),
                                max(1, RAY_CONFIG.lease_max_grants))
@@ -746,6 +755,11 @@ class Raylet:
                         _pool_obs()["hits"].inc()
                         extras.append(self._record_grant(
                             w2, resources, pg, bundle_index))
+                    short = want - 1 - len(extras)
+                    if short > 0 and not (renv and "pip" in renv):
+                        extras.extend(await self._spawn_grant_topup(
+                            short, job_hex, renv, renv_hash, resources,
+                            pg, bundle_index, deadline))
                     _pool_obs()["grant_batch"].observe(1 + len(extras))
                     reply = dict(grant, status="granted",
                                  node_id=self.node_id.hex())
@@ -774,6 +788,83 @@ class Raylet:
         finally:
             if parked_id is not None:
                 self._parked.pop(parked_id, None)
+
+    async def _spawn_grant_topup(self, short: int, job_hex: Optional[str],
+                                 renv: Optional[dict], renv_hash: str,
+                                 resources: Dict[str, float],
+                                 pg: Optional[bytes],
+                                 bundle_index: int,
+                                 deadline: float) -> List[dict]:
+        """Fork the under-granted remainder of a multi-grant lease reply
+        (grant warm now, fork the rest): a ``count=N`` request is served
+        with N grants instead of capping at currently-registered workers.
+        Doubles as the heterogeneous-shape fallback — a (job, runtime-env)
+        shape with NO warm workers at all still receives its full batch,
+        forked at the exact shape, rather than under-granting because the
+        pool was warmed for a different shape. Resources are debited up
+        front and credited back for forks that fail or miss the
+        registration window.
+
+        ``deadline`` is the enclosing lease request's deadline: every
+        registration wait is bounded by the time remaining, so the reply
+        ships before the OWNER's RPC timeout (worker_start_timeout_s + 30)
+        — a reply that outlived it would trigger an owner retry and grant
+        a second full batch, stranding the first batch's debited leases."""
+        if not self.provisioner.zygote_alive \
+                or time.monotonic() >= deadline:
+            return []
+        debited = 0
+        for _ in range(short):
+            if len(self.workers) + debited >= RAY_CONFIG.max_workers_per_node:
+                break
+            pool = self._lease_pool(pg, bundle_index)
+            if pool is None or not resources_ge(pool, resources):
+                break
+            resources_sub(pool, resources)
+            debited += 1
+        if not debited:
+            return []
+
+        async def _one():
+            try:
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    return None
+                async with self._spawn_sem:
+                    pid = await self.provisioner.fork_worker(renv)
+                    if pid is None:
+                        return None
+                    w = self._register_forked(pid, renv_hash)
+                    try:
+                        await asyncio.wait_for(
+                            w.registered,
+                            max(0.05, deadline - time.monotonic()))
+                    except asyncio.TimeoutError:
+                        # kill + untrack: a late registrant would strand in
+                        # self.workers without ever joining the idle pool
+                        try:
+                            w.proc.kill()
+                        except Exception as e:
+                            logger.debug("top-up reap of pid %d failed: %s",
+                                         w.pid, e)
+                        self.workers.pop(w.pid, None)
+                        return None
+                w.job_hex = job_hex
+                self.provisioner.stats["misses"] += 1
+                _pool_obs()["misses"].inc()
+                return self._record_grant(w, resources, pg, bundle_index)
+            except Exception:
+                logger.warning("spawn-backed lease top-up failed",
+                               exc_info=True)
+                return None
+
+        grants = [g for g in await asyncio.gather(
+            *[_one() for _ in range(debited)]) if g is not None]
+        for _ in range(debited - len(grants)):
+            pool = self._lease_pool(pg, bundle_index)
+            if pool is not None:
+                resources_add(pool, resources)
+        return grants
 
     def _record_grant(self, w: WorkerProc, resources: Dict[str, float],
                       pg: Optional[bytes], bundle_index: int) -> dict:

@@ -64,6 +64,56 @@ def set_enabled(value: Optional[bool]):
     _enabled = value
 
 
+def _append(entry) -> None:
+    """Bounded drop-oldest append — the single buffer-management path for
+    both dict events and SUBMITTED slab tuples."""
+    global _dropped
+    with _lock:
+        if len(_buffer) >= _MAX_BUFFER:
+            _buffer.popleft()
+            _dropped += 1
+        _buffer.append(entry)
+
+
+def _base_event(task_id_hex: str, state: str, ts: float, attempt: int,
+                name: str, job_id: str, span_id: str, parent_span: str,
+                arg_bytes: int) -> Dict[str, Any]:
+    """The field-elision ladder shared by :func:`record` and the slab
+    expansion — one source of truth for the event shape."""
+    event: Dict[str, Any] = {"task_id": task_id_hex, "state": state,
+                             "ts": ts, "attempt": attempt}
+    if name:
+        event["name"] = name
+    if job_id:
+        event["job_id"] = job_id
+    if span_id:
+        event["span_id"] = span_id
+    if parent_span:
+        event["parent_span"] = parent_span
+    if arg_bytes:
+        event["arg_bytes"] = int(arg_bytes)
+    return event
+
+
+def record_submitted(task_id_hex: str, ts: float, name: str, job_id: str,
+                     arg_bytes: int, span_id: str = "",
+                     parent_span: str = "") -> None:
+    """Slab append for the owner's SUBMITTED record — the one lifecycle
+    event that rides the ``.remote()`` hot loop. Appends a bare tuple;
+    :func:`drain` expands it into the normal event dict off the hot path
+    (flush time), so a 20k-task burst pays tuple-pack + append per task
+    instead of an 8-key dict construction."""
+    if not enabled():
+        return
+    _append((task_id_hex, ts, name, job_id, arg_bytes, span_id, parent_span))
+
+
+def _expand_submitted(slab: tuple) -> dict:
+    task_id_hex, ts, name, job_id, arg_bytes, span_id, parent_span = slab
+    return _base_event(task_id_hex, SUBMITTED, ts, 0, name, job_id,
+                       span_id, parent_span, arg_bytes)
+
+
 def record(task_id_hex: str, state: str, *, name: str = "", job_id: str = "",
            attempt: int = 0, error: str = "", worker: str = "",
            node: str = "", arg_bytes: int = 0, ret_bytes: int = 0,
@@ -79,18 +129,8 @@ def record(task_id_hex: str, state: str, *, name: str = "", job_id: str = "",
     to draw parent→child flow arrows without needing the span table."""
     if not enabled():
         return
-    event: Dict[str, Any] = {"task_id": task_id_hex, "state": state,
-                             "ts": time.time(), "attempt": attempt}
-    if name:
-        event["name"] = name
-    if job_id:
-        event["job_id"] = job_id
-    if span_id:
-        event["span_id"] = span_id
-    if parent_span:
-        event["parent_span"] = parent_span
-    if arg_bytes:
-        event["arg_bytes"] = int(arg_bytes)
+    event = _base_event(task_id_hex, state, time.time(), attempt,
+                        name, job_id, span_id, parent_span, arg_bytes)
     if ret_bytes:
         event["ret_bytes"] = int(ret_bytes)
     if error:
@@ -101,12 +141,7 @@ def record(task_id_hex: str, state: str, *, name: str = "", job_id: str = "",
         event["worker"] = worker
     if node:
         event["node"] = node
-    global _dropped
-    with _lock:
-        if len(_buffer) >= _MAX_BUFFER:
-            _buffer.popleft()
-            _dropped += 1
-        _buffer.append(event)
+    _append(event)
 
 
 def drain() -> Tuple[List[dict], int]:
@@ -119,7 +154,9 @@ def drain() -> Tuple[List[dict], int]:
         events, dropped = list(_buffer), _dropped
         _buffer.clear()
         _dropped = 0
-    return events, dropped
+    # slab entries (SUBMITTED hot path) expand here, off the submit loop
+    return [_expand_submitted(e) if type(e) is tuple else e
+            for e in events], dropped
 
 
 def rebuffer(events: List[dict], dropped: int = 0):

@@ -8,9 +8,15 @@ show the ratio on one host.
 
 Usage:
   python tools/bench_control_plane.py [--nodes 2] [--actors 40]
-      [--tasks 4000] [--lease-samples 50] [--out FILE]
+      [--tasks 4000] [--lease-samples 50] [--drivers 4] [--out FILE]
   python tools/bench_control_plane.py --compare --out STRESS_r06.json
       # runs warm then cold in fresh interpreters, emits both + speedups
+
+``--drivers K`` adds a multi-driver task phase: K driver PROCESSES submit
+against the same cluster concurrently (the reference runtime's shape —
+ownership is per-driver by design, PAPER.md L2), reporting per-driver and
+aggregate tasks/s. This is the number that proves the cluster side scales
+past the single-owner submission ceiling.
 """
 
 from __future__ import annotations
@@ -80,8 +86,67 @@ def phase_tasks(total: int, window: int = 1000) -> dict:
             submitted += 1
     dt = time.perf_counter() - t0
     assert completed == total, (completed, total)
-    return {"tasks": total, "tasks_wall_s": round(dt, 2),
-            "tasks_per_s": round(total / dt, 1)}
+    out = {"tasks": total, "tasks_wall_s": round(dt, 2),
+           "tasks_per_s": round(total / dt, 1)}
+    try:
+        from ray_tpu._private.worker import _global_worker
+
+        stats = _global_worker.submit_stats()
+        out["submit_per_task_us"] = stats["per_submit_us"]
+        out["submit_fast_path_frac"] = round(
+            stats["fast_path"] / max(1, stats["count"]), 3)
+        out["submit_kickoff_wakeups"] = stats["kickoff_wakeups"]
+        out["submit_spec_frames"] = stats["spec_frames"]
+    except Exception:
+        pass  # client/local modes have no core-worker submit stats
+    return out
+
+
+def phase_tasks_multidriver(drivers: int, total: int, address: str) -> dict:
+    """Fork `drivers` driver processes against the running cluster, each
+    submitting total/drivers no-op tasks. Aggregate tasks/s is measured
+    over the union window (first start to last finish), so driver skew
+    counts against it."""
+    per = max(1, total // drivers)
+    procs = []
+    for i in range(drivers):
+        out_path = f"/tmp/_bench_cp_driver{i}_{os.getpid()}.json"
+        cmd = [sys.executable, os.path.abspath(__file__), "--child-driver",
+               "--address", address, "--tasks", str(per), "--out", out_path]
+        procs.append((subprocess.Popen(cmd), out_path))
+    results = []
+    for proc, out_path in procs:
+        rc = proc.wait(timeout=1800)
+        assert rc == 0, f"driver subprocess failed (rc={rc})"
+        with open(out_path) as f:
+            results.append(json.load(f))
+        os.unlink(out_path)
+    window = max(r["t1"] for r in results) - min(r["t0"] for r in results)
+    agg = round(per * drivers / window, 1)
+    return {
+        "drivers": drivers,
+        "multidriver_tasks": per * drivers,
+        "multidriver_window_s": round(window, 2),
+        "per_driver_tasks_per_s": [r["tasks_per_s"] for r in results],
+        "aggregate_tasks_per_s": agg,
+        "driver_submit_per_task_us": results[0].get("submit_per_task_us"),
+    }
+
+
+def child_driver(address: str, tasks: int, out_path: str):
+    """One forked driver of the multi-driver phase: connect, submit, report
+    wall-clock endpoints (time.time() — comparable across processes)."""
+    import ray_tpu
+
+    ray_tpu.init(address=address)
+    try:
+        t0 = time.time()
+        result = phase_tasks(tasks)
+        result["t0"], result["t1"] = t0, time.time()
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+    finally:
+        ray_tpu.shutdown()
 
 
 def phase_lease_latency(samples: int) -> dict:
@@ -134,7 +199,8 @@ def pool_stats() -> dict:
     return out
 
 
-def run(nodes: int, actors: int, tasks: int, lease_samples: int) -> dict:
+def run(nodes: int, actors: int, tasks: int, lease_samples: int,
+        drivers: int = 1) -> dict:
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
 
@@ -162,6 +228,11 @@ def run(nodes: int, actors: int, tasks: int, lease_samples: int) -> dict:
         print(f"[bench] actors: {result['actor_creates_per_s']}/s", flush=True)
         result.update(phase_tasks(tasks))
         print(f"[bench] tasks: {result['tasks_per_s']}/s", flush=True)
+        if drivers > 1:
+            result.update(phase_tasks_multidriver(
+                drivers, tasks, cluster.address))
+            print(f"[bench] multidriver x{drivers}: "
+                  f"{result['aggregate_tasks_per_s']}/s aggregate", flush=True)
         result["worker_pools"] = pool_stats()
         result["total_wall_s"] = round(time.perf_counter() - wall0, 2)
         return result
@@ -204,14 +275,24 @@ def main():
     ap.add_argument("--actors", type=int, default=40)
     ap.add_argument("--tasks", type=int, default=4000)
     ap.add_argument("--lease-samples", type=int, default=50)
+    ap.add_argument("--drivers", type=int, default=1,
+                    help="run a K-driver-process task phase against the "
+                         "same cluster and report aggregate tasks/s")
     ap.add_argument("--compare", action="store_true",
                     help="run warm AND cold (fresh interpreters), emit both")
+    ap.add_argument("--child-driver", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: multidriver child
+    ap.add_argument("--address", default="")
     ap.add_argument("--out", default="")
     args = ap.parse_args()
+    if args.child_driver:
+        child_driver(args.address, args.tasks, args.out)
+        return
     if args.compare:
         result = compare(args)
     else:
-        result = run(args.nodes, args.actors, args.tasks, args.lease_samples)
+        result = run(args.nodes, args.actors, args.tasks, args.lease_samples,
+                     args.drivers)
     result["argv"] = sys.argv[1:]
     print(json.dumps(result, indent=1))
     if args.out:
