@@ -820,7 +820,7 @@ class CoreWorker:
             "count": 0, "serialize_s": 0.0, "events_s": 0.0,
             "kickoff_s": 0.0, "push_s": 0.0, "push_tasks": 0,
             "push_batches": 0, "spec_frames": 0, "kickoff_wakeups": 0,
-            "fast_path": 0}
+            "fast_path": 0, "pack_pool_hits": 0, "pack_pool_misses": 0}
         self._put_index = 0
         self._spread_hint = 0
         self.segments = SegmentCache()
@@ -2051,6 +2051,10 @@ class CoreWorker:
         self._spec_template_cache[id(remote_fn)] = (
             remote_fn, spec.function_key, spec.options, pool, blob)
 
+    # pooled-scratch ceiling: args bigger than this pack into a one-shot
+    # buffer instead of pinning multi-MB scratch per submitting thread
+    _PACK_SCRATCH_MAX = 4 << 20
+
     def _pack_args(self, args, kwargs):
         # inline small owned values so the executor need not call back
         def _inline(v):
@@ -2065,7 +2069,39 @@ class CoreWorker:
         args = tuple(_inline(a) for a in args)
         kwargs = {k: _inline(v) for k, v in kwargs.items()}
         with collect_serialized_refs() as arg_refs:
-            blob = pack_blob(*serialize((args, kwargs)))
+            inband, buffers = serialize((args, kwargs))
+        # pooled serialization scratch (per submitting thread — submits
+        # come from user threads as well as the loop): pack into a reused
+        # bytearray and snapshot once, instead of pack_blob's
+        # alloc-bytearray + copy-to-bytes per call. At ~31 µs/submit the
+        # per-driver ceiling is arg-serialization-bound (STRESS_r07);
+        # killing the large-allocation churn is the cheap half of that.
+        stats = self._submit_stats
+        total, offsets = plan_layout(inband, buffers)
+        scratch = getattr(self._tls, "pack_scratch", None)
+        if scratch is not None and len(scratch) >= total:
+            stats["pack_pool_hits"] += 1
+        else:
+            stats["pack_pool_misses"] += 1
+            size = min(max(total, 64 << 10), self._PACK_SCRATCH_MAX)
+            if total <= self._PACK_SCRATCH_MAX:
+                scratch = self._tls.pack_scratch = bytearray(size)
+            else:  # oversized: one-shot buffer, never pooled
+                scratch = bytearray(total)
+        write_blob(scratch, inband, buffers, offsets)
+        # pack_blob's fresh bytearray had zeroed alignment gaps; the
+        # reused scratch keeps a PRIOR submit's bytes there — zero the
+        # gaps (each <64 B) so blobs stay deterministic and never leak
+        # another task's argument fragments to the executor
+        mv = memoryview(scratch)
+        prev_end = 16 + 16 * len(buffers) + len(inband)
+        for b, off in zip(buffers, offsets):
+            if off > prev_end:
+                mv[prev_end:off] = bytes(off - prev_end)
+            prev_end = off + b.nbytes
+        if total > prev_end:
+            mv[prev_end:total] = bytes(total - prev_end)
+        blob = bytes(mv[:total])
         return blob, arg_refs
 
     async def _resolve_dependencies(self, record: dict):

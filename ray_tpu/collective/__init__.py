@@ -38,6 +38,8 @@ __all__ = [
     "barrier",
     "ReduceOp",
     "Backend",
+    "get_group",
+    "allreduce_quantized",
     # bucketed async tier (collective/bucketed.py): lazy attrs below
     "plan_buckets",
     "leaf_meta",
@@ -46,18 +48,32 @@ __all__ = [
     "AsyncBucketReducer",
     "ShardedBucketOptimizer",
     "init_sharded_optimizer_groups",
+    # quantized tier (collective/quant.py): lazy attrs below
+    "QuantCodec",
+    "QuantizedTensor",
+    "ErrorFeedback",
+    "resolve_codec",
+    "quantize",
+    "dequantize",
 ]
 
 _BUCKETED = ("plan_buckets", "leaf_meta", "BucketPlan", "Bucket",
              "AsyncBucketReducer", "ShardedBucketOptimizer",
              "init_sharded_optimizer_groups")
 
+_QUANT = ("QuantCodec", "QuantizedTensor", "ErrorFeedback", "resolve_codec",
+          "quantize", "dequantize")
 
-def __getattr__(name):  # lazy: bucketed pulls numpy/jax helpers
+
+def __getattr__(name):  # lazy: bucketed/quant pull numpy/jax helpers
     if name in _BUCKETED:
         from ray_tpu.collective import bucketed
 
         return getattr(bucketed, name)
+    if name in _QUANT:
+        from ray_tpu.collective import quant
+
+        return getattr(quant, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -127,6 +143,19 @@ def get_rank(group_name: str = "default") -> int:
 
 def get_collective_group_size(group_name: str = "default") -> int:
     return _manager.get(group_name).world_size
+
+
+def get_group(group_name: str = "default"):
+    """The initialized group object itself (backend-specific ops like
+    ``allreduce_quantized`` / ``broadcast_obj`` live on it)."""
+    return _manager.get(group_name)
+
+
+def allreduce_quantized(wire: dict, codec, group_name: str = "default") -> dict:
+    """Quantized-SUM allreduce of an encoded contribution (see
+    ``collective/quant.py``); CPU backend only — the XLA tier quantizes
+    inside compiled programs."""
+    return _manager.get(group_name).allreduce_quantized(wire, codec)
 
 
 def allreduce(tensor, op: ReduceOp = ReduceOp.SUM, group_name: str = "default"):

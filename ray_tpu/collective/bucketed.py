@@ -69,6 +69,15 @@ def _obs() -> dict:
                     "ray_tpu.train.buckets_reduced",
                     "grad buckets reduced through the async bucketed "
                     "collective path"),
+                "quant_saved": Counter(
+                    "ray_tpu.train.quant_bytes_saved",
+                    "wire bytes saved by the quantized collective tier vs "
+                    "shipping fp32 on both legs (contribute + broadcast)"),
+                "quant_encode": Histogram(
+                    "ray_tpu.train.quant_encode_seconds",
+                    "CPU time spent encoding/decoding one quantized bucket "
+                    "payload (quantize + error-feedback + dequantize)",
+                    boundaries=[0.00001, 0.0001, 0.001, 0.01, 0.1]),
             }
         return _metrics
 
@@ -241,10 +250,19 @@ class AsyncBucketReducer:
     """
 
     def __init__(self, group_name: str, plan: BucketPlan, *,
-                 average: bool = False):
+                 average: bool = False, compression: Any = None):
+        from ray_tpu.collective.quant import ErrorFeedback, resolve_codec
+
         self.group_name = group_name
         self.plan = plan
         self.average = average
+        # strictly opt-in: with compression=None the reduce path below is
+        # byte-identical to the uncompressed tier (regression-asserted)
+        self.codec = resolve_codec(compression)
+        self._ef = ErrorFeedback(self.codec) if self.codec else None
+        self._wire_lock = threading.Lock()
+        self._wire = {"bytes_fp32_equiv": 0, "bytes_wire": 0,
+                      "buckets_quantized": 0, "encode_s": 0.0}
         self._queue: "List[Tuple[Bucket, Dict[str, np.ndarray], Any, BucketHandle]]" = []
         self._cv = threading.Condition()
         self._stop = False
@@ -311,25 +329,79 @@ class AsyncBucketReducer:
         t0 = time.time()
         packed = _pack(leaves)
         out = []
+        wire_up = wire_down = 0
         for dtype, flat, layout in packed:
-            reduced = np.asarray(col.allreduce(flat,
-                                               group_name=self.group_name))
+            if self.codec is not None and np.issubdtype(dtype, np.floating):
+                reduced, up, down = self._reduce_quantized(bucket, dtype,
+                                                           flat)
+                reduced = reduced.astype(dtype, copy=False)
+                wire_up += up
+                wire_down += down
+            else:
+                reduced = np.asarray(col.allreduce(
+                    flat, group_name=self.group_name))
             if self.average:
                 reduced = reduced / self.plan.world_size
             out.append((dtype, reduced, layout))
         result = _unpack(out)
         end = time.time()
+        span_extra = {}
+        if self.codec is not None:
+            span_extra = {"compression": self.codec.name,
+                          "wire_bytes": wire_up + wire_down}
         tracing.record_span(
             "train.bucket_allreduce", t0, end, category="train",
             trace_id=ctx[0] if ctx else tracing.new_trace_id(),
             span_id=tracing.new_span_id(),
             parent_id=ctx[1] if ctx else None,
             bucket=bucket.index, nbytes=bucket.nbytes, owner=bucket.owner,
-            leaves=len(bucket.paths))
+            leaves=len(bucket.paths), **span_extra)
         obs["allreduce"].observe(end - t0)
         obs["bucket_bytes"].observe(bucket.nbytes)
         obs["buckets"].inc()
         return result
+
+    def _reduce_quantized(self, bucket: Bucket, dtype, flat: np.ndarray
+                          ) -> Tuple[np.ndarray, int, int]:
+        """One dtype-vector's quantized allreduce: error-feedback encode
+        on the contribute leg, fp32 dequant-accumulate at the store's
+        reduce point, one re-quantized broadcast leg (see quant.py)."""
+        from ray_tpu import collective as col
+        from ray_tpu.collective import quant
+
+        obs = _obs()
+        t0 = time.perf_counter()
+        qt = self._ef.encode((bucket.index, str(dtype)), flat)
+        wire = quant.to_wire(qt)
+        enc_s = time.perf_counter() - t0
+        out_wire = col.allreduce_quantized(wire, self.codec,
+                                           group_name=self.group_name)
+        t1 = time.perf_counter()
+        reduced = quant.dequantize(quant.from_wire(out_wire)).astype(
+            np.float32, copy=False)
+        enc_s += time.perf_counter() - t1
+        up, down = quant.wire_nbytes(wire), quant.wire_nbytes(out_wire)
+        fp32_equiv = int(flat.astype(np.float32, copy=False).nbytes) * 2
+        obs["quant_encode"].observe(enc_s)
+        obs["quant_saved"].inc(max(fp32_equiv - (up + down), 0))
+        with self._wire_lock:
+            self._wire["bytes_fp32_equiv"] += fp32_equiv
+            self._wire["bytes_wire"] += up + down
+            self._wire["buckets_quantized"] += 1
+            self._wire["encode_s"] += enc_s
+        return reduced, up, down
+
+    def wire_stats(self) -> Dict[str, Any]:
+        """Cumulative wire-byte accounting of the quantized path (both
+        legs; ``bytes_fp32_equiv`` is what the same traffic costs
+        uncompressed). Empty-ish when compression is off."""
+        with self._wire_lock:
+            s = dict(self._wire)
+        s["compression"] = self.codec.name if self.codec else None
+        if s["bytes_wire"]:
+            s["wire_reduction_x"] = round(
+                s["bytes_fp32_equiv"] / s["bytes_wire"], 2)
+        return s
 
     def shutdown(self, timeout: float = 30.0):
         with self._cv:
@@ -382,8 +454,11 @@ class ShardedBucketOptimizer:
 
     def __init__(self, group_name: str, plan: BucketPlan, rank: int,
                  optimizer, params: Any, *, clip_global_norm:
-                 Optional[float] = None, grad_scale: float = 1.0):
+                 Optional[float] = None, grad_scale: float = 1.0,
+                 compression: Any = None):
         import jax
+
+        from ray_tpu.collective.quant import ErrorFeedback, resolve_codec
 
         self.group_name = group_name
         self.plan = plan
@@ -391,6 +466,14 @@ class ShardedBucketOptimizer:
         self.optimizer = optimizer
         self.clip = clip_global_norm
         self.grad_scale = grad_scale
+        # compression=None keeps BOTH legs on the PR 12 fp32 path
+        # (bit-identical collective sequence; regression-asserted); a codec
+        # quantizes the grad reduce (inside the reducer, with error
+        # feedback) AND the param-refresh broadcast — which then ships the
+        # quantized param DELTA (new - old) so precision loss is bounded
+        # by one step's update and error-fed into the next broadcast.
+        self.codec = resolve_codec(compression)
+        self._bcast_ef = ErrorFeedback(self.codec) if self.codec else None
         flat, self._treedef = jax.tree_util.tree_flatten_with_path(params)
         self._paths = [jax.tree_util.keystr(k) for k, _ in flat]
         self._leaf_idx = {p: i for i, p in enumerate(self._paths)}
@@ -400,7 +483,8 @@ class ShardedBucketOptimizer:
             b.index: optimizer.init(self._subtree(b))
             for b in plan.owned(rank)
         }
-        self._reducer = AsyncBucketReducer(group_name, plan)
+        self._reducer = AsyncBucketReducer(group_name, plan,
+                                           compression=compression)
 
     def _subtree(self, bucket: Bucket) -> Dict[str, np.ndarray]:
         return {p: self._by_path[p] for p in bucket.paths}
@@ -471,7 +555,13 @@ class ShardedBucketOptimizer:
         # broadcast refreshed buckets from their owners (deterministic
         # bucket order on every rank)
         t2 = time.perf_counter()
+        bcast_wire = bcast_fp32 = 0
         for b in self.plan.buckets:
+            if self.codec is not None:
+                up, down = self._broadcast_bucket_quantized(b, updated)
+                bcast_wire += up + down
+                bcast_fp32 += b.nbytes
+                continue
             packed = _pack({p: (updated[p] if b.owner == self.rank
                                 else self._by_path[p])
                             for p in b.paths})
@@ -486,7 +576,7 @@ class ShardedBucketOptimizer:
         broadcast_s = time.perf_counter() - t2
         leaves = [self._by_path[p] for p in self._paths]
         tree = jax.tree_util.tree_unflatten(self._treedef, leaves)
-        return tree, {
+        stats = {
             "allreduce_s": allreduce_s,
             "optimizer_s": optimizer_s,
             "broadcast_s": broadcast_s,
@@ -495,6 +585,84 @@ class ShardedBucketOptimizer:
             "opt_state_bytes": self.opt_state_bytes(),
             "owned_buckets": sorted(owned),
         }
+        if self.codec is not None:
+            stats["compression"] = self.codec.name
+            stats["broadcast_wire_bytes"] = bcast_wire
+            stats["broadcast_fp32_bytes"] = bcast_fp32
+            stats["reduce_wire"] = self._reducer.wire_stats()
+        return tree, stats
+
+    def _broadcast_bucket_quantized(self, bucket: Bucket,
+                                    updated: Dict[str, np.ndarray]
+                                    ) -> Tuple[int, int]:
+        """The compressed param-refresh leg: the owner ships the quantized
+        param DELTA of its bucket (with error feedback), every rank —
+        owner included — applies ``base + dequant(delta)`` to its local
+        copy, so ranks stay bitwise identical while the wire carries
+        ~1 byte/element. The owner's exact-vs-broadcast difference is the
+        EF residual, folded into the next step's delta."""
+        from ray_tpu import collective as col
+        from ray_tpu.collective import quant
+
+        group = col.get_group(f"{self.group_name}.norm")
+        # quantized deltas only make sense for float leaves — an int32
+        # counter whose +1 delta dequantizes to 0.98 would truncate back
+        # to base and never advance; non-float leaves ship their raw
+        # updated values (same guard as the reduce leg's _pack dispatch)
+        float_paths = [p for p in bucket.paths
+                       if np.issubdtype(self._by_path[p].dtype,
+                                        np.floating)]
+        fset = set(float_paths)
+        raw_paths = [p for p in bucket.paths if p not in fset]
+        payload = None
+        enc_s = 0.0
+        if bucket.owner == self.rank:
+            t0 = time.perf_counter()
+            deltas = {p: updated[p].astype(np.float32)
+                      - self._by_path[p].astype(np.float32)
+                      for p in float_paths}
+            items = []
+            for dtype, flatv, layout in _pack(deltas):
+                qt = self._bcast_ef.encode(("bcast", bucket.index,
+                                            str(dtype)), flatv)
+                items.append((str(dtype), quant.to_wire(qt), layout))
+            enc_s += time.perf_counter() - t0
+            payload = (items, {p: updated[p] for p in raw_paths})
+        items, raw = group.broadcast_obj(payload, src_rank=bucket.owner)
+        t1 = time.perf_counter()
+        up = down = 0
+        for dtype, wire, layout in items:
+            nb = quant.wire_nbytes(wire)
+            down += nb
+            if bucket.owner == self.rank:
+                up += nb
+            delta = quant.dequantize(quant.from_wire(wire))
+            off = 0
+            for p, shape in layout:
+                n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+                base = self._by_path[p]
+                self._by_path[p] = (
+                    base.astype(np.float32)
+                    + delta[off:off + n].reshape(shape)
+                ).astype(base.dtype)
+                off += n
+        for p, val in raw.items():
+            nb = int(np.asarray(val).nbytes)
+            down += nb
+            if bucket.owner == self.rank:
+                up += nb
+            self._by_path[p] = np.asarray(val)
+        obs = _obs()
+        # encode/decode CPU time only — the broadcast rendezvous itself is
+        # excluded (matches the metric description and _reduce_quantized)
+        obs["quant_encode"].observe(enc_s + time.perf_counter() - t1)
+        # uncompressed equivalent: float leaves would ship 4 B/el; raw
+        # leaves ship at their actual size either way (no savings there)
+        fp32 = sum(int(np.prod(self._by_path[p].shape, dtype=np.int64)) * 4
+                   for p in float_paths)
+        fp32 += sum(int(self._by_path[p].nbytes) for p in raw_paths)
+        obs["quant_saved"].inc(max(fp32 - down, 0))
+        return up, down
 
     def shutdown(self):
         self._reducer.shutdown()

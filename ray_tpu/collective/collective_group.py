@@ -75,6 +75,15 @@ class CollectiveStore:
             ordered = [slot[r] for r in range(self.world_size)]
             if op_name is None:
                 self._results[key] = ordered  # allgather
+            elif op_name.startswith("qsum:"):
+                # quantized allreduce reduce point: dequant-accumulate the
+                # uint8+scales contributions in fp32, re-quantize ONCE for
+                # the broadcast leg (collective/quant.py) — wire bytes are
+                # quantized in BOTH directions
+                from ray_tpu.collective import quant
+
+                self._results[key] = quant.reduce_wire_payloads(
+                    ordered, op_name[len("qsum:"):])
             else:
                 self._results[key] = _reduce_np(ordered, ReduceOp(op_name))
             ev.set()  # wake every parked member — no polling
@@ -159,6 +168,27 @@ class CpuStoreGroup:
         key = self._next_key("ar")
         out = self._sync(self.store.collect.remote(key, self.rank, np.asarray(tensor), op.value))
         return out
+
+    def allreduce_quantized(self, wire: dict, codec) -> dict:
+        """Quantized-SUM allreduce: ``wire`` is this rank's encoded
+        contribution (``quant.to_wire``); the store dequant-accumulates in
+        fp32 and re-quantizes once, so both wire legs carry
+        ``codec.bytes_per_element`` per element instead of 4. Returns the
+        encoded sum (decode with ``quant.from_wire`` + ``dequantize``)."""
+        key = self._next_key("qar")
+        return self._sync(self.store.collect.remote(
+            key, self.rank, wire, f"qsum:{codec.spec()}"))
+
+    def broadcast_obj(self, payload, src_rank: int = 0):
+        """One-to-all broadcast of an arbitrary payload where ONLY the
+        source uploads bytes (plain ``broadcast`` collects a full tensor
+        from every rank — pointless upload for N-1 of them). The
+        compressed param-broadcast leg rides this."""
+        key = self._next_key("bco")
+        gathered = self._sync(self.store.collect.remote(
+            key, self.rank, payload if self.rank == src_rank else None,
+            None))
+        return gathered[src_rank]
 
     def allgather(self, tensor):
         key = self._next_key("ag")
@@ -321,6 +351,13 @@ class XlaGroup:
 
         x = jnp.asarray(tensor)
         return self._op(f"a2a_{x.shape}_{x.dtype}", build)(x)
+
+    def allreduce_quantized(self, wire: dict, codec) -> dict:
+        raise NotImplementedError(
+            "the XLA tier quantizes INSIDE compiled programs — use "
+            "collective.quant.quantized_psum_scatter_1d (or the traced "
+            "TrainStepBundle compression= path) instead of the explicit "
+            "store-actor exchange; the CPU backend implements this method")
 
     def broadcast(self, tensor, src_rank: int = 0):
         import jax

@@ -160,19 +160,46 @@ class TrainStepBundle:
                  rules=LOGICAL_RULES, donate: bool = True,
                  shard_update: bool = False,
                  bucket_bytes: int = 32 << 20,
-                 optimizer_factory: Optional[Callable] = None):
+                 optimizer_factory: Optional[Callable] = None,
+                 grad_dtype: str = "fp32",
+                 compression: Optional[str] = None):
         jax = import_jax()
         import flax.linen as nn
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.collective.quant import resolve_codec
 
         self.cfg = cfg
         self.mesh = mesh
         self.model = Transformer(cfg)
         self.rules = rules
         self.bucket_bytes = bucket_bytes
+        if grad_dtype not in ("fp32", "bf16"):
+            raise ValueError(f"grad_dtype must be fp32 or bf16, got "
+                             f"{grad_dtype!r}")
+        # "bf16": grads are narrowed to bf16 for the cross-replica
+        # reduce-scatter (half the collective bytes; explicit on the
+        # traced bucket programs, a value-narrowing cast pair on the
+        # one-program path) while optimizer state and params stay fp32
+        # master copies. Default "fp32" keeps every program bit-identical
+        # to previous releases.
+        self.grad_dtype = grad_dtype
+        # block-quantized wire for the traced bucket programs (the
+        # EQuARX-style XLA tier): each data-sharded leaf's reduce-scatter
+        # becomes quantize -> all_to_all (uint8 codes + fp32 block scales
+        # on the wire) -> fp32 dequant-accumulate. Strictly opt-in; the
+        # one-program untraced path never quantizes.
+        self._codec = resolve_codec(compression)
         axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         self.dp_size = int(axis_sizes.get("data", 1))
         self.shard_update = bool(shard_update) and self.dp_size > 1
+        self._warned_untraced = False
+        if self._codec is not None and not self.shard_update:
+            raise ValueError(
+                f"compression={compression!r} requires shard_update=True "
+                f"on a mesh with data>1 (data={self.dp_size}) — the "
+                f"quantized wire exists only in the traced sharded bucket "
+                f"programs; it would be silently ignored here")
 
         def clip_spec_fn(shape):
             return self._norm_spec(shape)
@@ -233,6 +260,7 @@ class TrainStepBundle:
         def train_step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(
                 params, batch["tokens"], batch["targets"], batch.get("mask"))
+            grads = self._narrow_grads(grads)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             import optax
 
@@ -271,8 +299,9 @@ class TrainStepBundle:
         # each phase); the untraced path keeps the fused program — and its
         # fusion/donation — untouched
         def fwd_bwd(params, batch):
-            return jax.value_and_grad(loss_fn)(
+            loss, grads = jax.value_and_grad(loss_fn)(
                 params, batch["tokens"], batch["targets"], batch.get("mask"))
+            return loss, self._narrow_grads(grads)
 
         self._fwd_bwd = jax.jit(
             fwd_bwd,
@@ -338,6 +367,21 @@ class TrainStepBundle:
         self.eval_step = jax.jit(eval_step)
 
     # -- sharding helpers -------------------------------------------------
+
+    def _narrow_grads(self, grads):
+        """``grad_dtype="bf16"``: round grads through bf16 before the
+        optimizer. On the one-program path this narrows the values the
+        cross-replica reduction consumes (the collective's placement is
+        XLA's; the traced bucket programs make the bf16 wire explicit);
+        opt state and params remain fp32 master copies. A no-op at
+        fp32 — the default program is untouched."""
+        if self.grad_dtype != "bf16":
+            return grads
+        jax = import_jax()
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.bfloat16).astype(g.dtype), grads)
 
     def _update_sharding(self, abstract_leaf, base_sharding):
         """The cross-replica update sharding for one leaf: append the
@@ -519,13 +563,61 @@ class TrainStepBundle:
                     return d
             return None
 
+        codec = self._codec
+        bf16_wire = self.grad_dtype == "bf16"
+
+        def _q_rs_leaf(v, d):
+            """Quantized reduce-scatter of one leaf on dim ``d``: split
+            into per-owner parts along ``d``, block-quantize each part,
+            ``all_to_all`` the uint8 codes + fp32 scales (the wire leg —
+            1 byte/element instead of 4), dequant-accumulate in fp32.
+            Output == psum_scatter(v, scatter_dimension=d, tiled=True) to
+            quantization error. Stateless (no error feedback) — EF lives
+            in the explicit tier where residuals can persist."""
+            from ray_tpu.collective.quant import jnp_block_encode
+
+            block = codec.block
+            vm = jnp.moveaxis(v, d, 0)
+            rest = vm.shape[1:]
+            seg = vm.shape[0] // dp
+            flat = vm.reshape(dp, -1)
+            m = flat.shape[1]
+            nb = -(-m // block)
+            if nb * block != m:
+                flat = jnp.pad(flat, ((0, 0), (0, nb * block - m)))
+            if codec.name == "bf16":  # narrow wire dtype, no scales
+                qg = jax.lax.all_to_all(
+                    flat.reshape(dp, nb * block).astype(jnp.bfloat16),
+                    "data", split_axis=0, concat_axis=0, tiled=False)
+                summed = jnp.sum(qg.astype(jnp.float32), axis=0)[:m]
+                return jnp.moveaxis(summed.reshape((seg,) + rest), 0, d)
+            q, scale = jnp_block_encode(flat.reshape(dp, nb, block),
+                                        codec.name)
+            qg = jax.lax.all_to_all(q, "data", split_axis=0, concat_axis=0,
+                                    tiled=False)
+            sg = jax.lax.all_to_all(scale, "data", split_axis=0,
+                                    concat_axis=0, tiled=False)
+            vals = qg.astype(jnp.float32) * sg[..., None]
+            summed = jnp.sum(vals, axis=0).reshape(-1)[:m]
+            return jnp.moveaxis(summed.reshape((seg,) + rest), 0, d)
+
         def make_bucket_rs(paths):
             dims = [_data_dim(sh_by_path[p]) for p in paths]
 
             def f(*stacked):
                 outs = []
                 for x, d in zip(stacked, dims):
-                    if d is not None:
+                    if d is not None and codec is not None:
+                        # quantized wire; tiny/replicated leaves below
+                        # stay fp32 (QUANT.md: never quantize the
+                        # few-float legs)
+                        y = _q_rs_leaf(x[0], d)
+                    elif d is not None and bf16_wire:
+                        y = jax.lax.psum_scatter(
+                            x[0].astype(jnp.bfloat16), "data",
+                            scatter_dimension=d,
+                            tiled=True).astype(jnp.float32)
+                    elif d is not None:
                         y = jax.lax.psum_scatter(
                             x[0], "data", scatter_dimension=d, tiled=True)
                     else:
@@ -629,6 +721,18 @@ class TrainStepBundle:
 
         t0 = time.perf_counter()
         if not tracing.enabled():
+            if self._codec is not None and not self._warned_untraced:
+                # the quantized bucket programs only exist on the traced
+                # path — surface the silent-fp32 trap instead of letting
+                # benchmarks report compression that never engaged
+                self._warned_untraced = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "TrainStepBundle(compression=%s): tracing is "
+                    "disabled, so this step runs the fused fp32 program "
+                    "— the quantized wire needs tracing ON "
+                    "(RAY_TPU_ENABLE_TRACING=1)", self._codec.spec())
             fn = (self._fused_step_sharded if self.shard_update
                   else self._fused_step)
             out = fn(params, opt_state, batch)

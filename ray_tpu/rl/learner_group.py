@@ -26,13 +26,23 @@ import numpy as np
 import ray_tpu
 
 
-def sync_gradients(grads, scalars: np.ndarray, group_name: str):
-    """Allreduce-SUM a gradient pytree and a metrics vector in ONE
-    collective call. Returns (reduced_grads, reduced_scalars).
+def sync_gradients(grads, scalars: np.ndarray, group_name: str,
+                   compression=None, error_feedback=None):
+    """Allreduce-SUM a gradient pytree and a metrics vector. Returns
+    (reduced_grads, reduced_scalars).
 
     The caller is responsible for scaling: local grads must already be
     global-denominator contributions (sum over ranks == the global-batch
     gradient), and scalars likewise — the sum across ranks IS the value.
+
+    With ``compression`` (int8/fp8/bf16, collective/quant.py) the grad
+    vector rides the quantized allreduce (~4x fewer wire bytes for int8:
+    quantized contribute leg, fp32 accumulation at the reduce point, one
+    re-quantized broadcast leg) while the METRICS vector stays on a plain
+    fp32 allreduce — normalization statistics and loss scalars are
+    few-float control values, exactly the "when NOT to quantize" case
+    (QUANT.md). Pass a persistent ``quant.ErrorFeedback`` so quantization
+    error carries into the next step instead of accumulating as bias.
     """
     from ray_tpu import collective as col
     from ray_tpu.utils import import_jax
@@ -40,11 +50,29 @@ def sync_gradients(grads, scalars: np.ndarray, group_name: str):
     import_jax()
     from jax.flatten_util import ravel_pytree
 
+    from ray_tpu.collective import quant
+
     flat, unravel = ravel_pytree(grads)
     flat = np.asarray(flat, np.float32)
-    vec = np.concatenate([flat, np.asarray(scalars, np.float32)])
-    out = np.asarray(col.allreduce(vec, group_name=group_name))
-    return unravel(out[: flat.size]), out[flat.size:]
+    # resolve BEFORE branching: "none"/"off"/"fp32" spellings mean off
+    codec = quant.resolve_codec(compression)
+    if codec is None:
+        vec = np.concatenate([flat, np.asarray(scalars, np.float32)])
+        out = np.asarray(col.allreduce(vec, group_name=group_name))
+        return unravel(out[: flat.size]), out[flat.size:]
+    if error_feedback is not None:
+        qt = error_feedback.encode("sync_gradients", flat)
+    else:
+        qt = quant.quantize(flat, codec)
+    # the metrics vector rides the SAME exchange as a raw fp32 "extra"
+    # (summed exactly at the reduce point): one collective round trip,
+    # and the few-float leg is never quantized
+    out_wire = col.allreduce_quantized(
+        quant.to_wire(qt, extra=np.asarray(scalars, np.float32)), codec,
+        group_name=group_name)
+    reduced = quant.dequantize(quant.from_wire(out_wire)).astype(np.float32)
+    out_scalars = np.asarray(out_wire["extra"], np.float32)
+    return unravel(reduced), out_scalars
 
 
 class _LearnerWorker:
@@ -63,6 +91,8 @@ class _LearnerWorker:
         if world_size > 1:
             col.init_collective_group(world_size, rank, backend=backend,
                                       group_name=group_name)
+        # store name -> last published version (delta bases)
+        self._published: Dict[str, int] = {}
         factory: Callable = loads_trusted(factory_blob)
         self.core = factory(rank=rank, world_size=world_size,
                             group_name=group_name if world_size > 1 else None)
@@ -81,17 +111,27 @@ class _LearnerWorker:
         return jax.tree.map(np.asarray, self.core.get_params())
 
     def publish_weights(self, store_name: str, version=None,
-                        durable: bool = False) -> int:
+                        durable: bool = False, delta: bool = False,
+                        compression=None) -> int:
         """Publish current params to the named WeightStore from INSIDE the
         learner — the driver never relays weight bytes. Env-runners pull
-        via weights.WeightSync (see env_runner.py)."""
+        via weights.WeightSync (see env_runner.py).
+
+        ``delta=True`` publishes against this learner's PREVIOUS publish
+        to the same store (only changed leaves cross the wire — the first
+        publish, and any whose base was retired, go full). ``compression``
+        quantizes the chunk payloads (collective/quant.py codecs)."""
         from ray_tpu.utils import import_jax
         from ray_tpu.weights import WeightStore
 
         jax = import_jax()
         params = jax.tree.map(np.asarray, self.core.get_params())
-        return WeightStore(store_name).publish(params, version=version,
-                                               durable=durable)
+        delta_from = self._published.get(store_name) if delta else None
+        ver = WeightStore(store_name).publish(
+            params, version=version, durable=durable,
+            delta_from=delta_from, compression=compression)
+        self._published[store_name] = ver
+        return ver
 
     def get_state(self):
         return self.core.get_state()
@@ -169,14 +209,18 @@ class LearnerGroup:
                     timeout=300)
 
     def publish_weights(self, store_name: str, version=None,
-                        durable: bool = False) -> int:
+                        durable: bool = False, delta: bool = False,
+                        compression=None) -> int:
         """Broadcast current params through the weight plane: rank 0
         publishes (learner params are replicated by the sync contract) and
         every subscribed env-runner pulls the new version. Returns the
-        published version (monotonic per store)."""
+        published version (monotonic per store). ``delta``/``compression``
+        route the quantized + delta publish tier (see
+        ``_LearnerWorker.publish_weights``)."""
         return ray_tpu.get(
             self.workers[0].publish_weights.remote(store_name, version,
-                                                   durable),
+                                                   durable, delta,
+                                                   compression),
             timeout=300)
 
     def foreach_learner(self, method: str, *args, **kwargs) -> List[Any]:

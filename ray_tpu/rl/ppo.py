@@ -39,6 +39,11 @@ class PPOConfig(AlgorithmConfig):
     # num_learners); backend "cpu" = CpuStoreGroup CI tier, "xla" = ICI
     num_learners: int = 1
     learner_backend: str = "cpu"
+    # wire compression of the gradient allreduce (collective/quant.py):
+    # None = fp32 (bit-identical to previous releases), "int8"/"fp8"
+    # block-quantized with error feedback, "bf16" plain narrowing.
+    # Advantage-normalization stats always stay fp32 (QUANT.md).
+    grad_compression: Optional[str] = None
 
     @property
     def algo_cls(self):
@@ -170,6 +175,28 @@ class PPOLearner:
         self.world_size = world_size
         self.rank = rank
         self.group_name = group_name
+        # quantized grad sync: per-learner error-feedback residual so the
+        # int8/fp8 wire stays unbiased across updates (quant.py)
+        self._grad_compression = None
+        self._grad_ef = None
+        if world_size > 1:
+            from ray_tpu.collective import quant
+
+            codec = quant.resolve_codec(getattr(cfg, "grad_compression",
+                                                None))
+            if codec is not None:
+                # fail at learner construction, not the first update:
+                # only the CPU store-actor backend implements the
+                # explicit quantized exchange (same setup-time guard as
+                # TrainWorker.setup_grad_sync)
+                backend = getattr(cfg, "learner_backend", "cpu")
+                if backend != "cpu":
+                    raise ValueError(
+                        f"grad_compression requires "
+                        f"learner_backend='cpu' (got {backend!r}); the "
+                        f"XLA tier quantizes inside compiled programs")
+                self._grad_compression = codec
+                self._grad_ef = quant.ErrorFeedback(codec)
 
         def loss_fn(params, batch):
             logits, values = self.model.apply({"params": params}, batch["obs"])
@@ -297,8 +324,10 @@ class PPOLearner:
                 std = max(float(stats[2]) / wsum - mean * mean, 0.0) ** 0.5
                 grads, scalars = self._grad_shard(
                     self.params, mbatch, mw, mean, std, wsum)
-                grads, mvec = sync_gradients(grads, _np.asarray(scalars),
-                                             self.group_name)
+                grads, mvec = sync_gradients(
+                    grads, _np.asarray(scalars), self.group_name,
+                    compression=self._grad_compression,
+                    error_feedback=self._grad_ef)
                 self.params, self.opt_state = self._apply_grads(
                     self.params, self.opt_state, grads)
         return {"loss": float(mvec[0]), "pg_loss": float(mvec[1]),

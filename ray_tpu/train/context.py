@@ -64,25 +64,32 @@ class TrainContext:
                 "ScalingConfig.grad_sync_backend")
         return self.grad_sync
 
-    def make_bucket_reducer(self, params_like: Any):
+    def make_bucket_reducer(self, params_like: Any,
+                            compression: Any = "__default__"):
         """An AsyncBucketReducer over this group's grad-sync plane, with a
         bucket plan derived from ``params_like`` (every worker must build
         it over the same tree — bucket order is the collective order).
         Rides the dedicated ``.user`` sibling group so it can never
         interleave with a sharded optimizer's internal reducer; keep at
-        most ONE live reducer per worker."""
+        most ONE live reducer per worker. ``compression`` defaults to
+        ``ScalingConfig.grad_sync_compression`` (pass None/int8/fp8/bf16
+        to override per reducer; every rank must pick the same)."""
         from ray_tpu.collective.bucketed import (AsyncBucketReducer,
                                                  leaf_meta, plan_buckets)
 
         gs = self._require_grad_sync()
+        if compression == "__default__":
+            compression = gs.get("compression")
         plan = plan_buckets(leaf_meta(params_like),
                             bucket_bytes=gs["bucket_bytes"],
                             world_size=self.world_size)
-        return AsyncBucketReducer(f"{gs['group']}.user", plan)
+        return AsyncBucketReducer(f"{gs['group']}.user", plan,
+                                  compression=compression)
 
     def make_sharded_optimizer(self, optimizer, params, *,
                                clip_global_norm: Optional[float] = None,
-                               grad_scale: float = 1.0):
+                               grad_scale: float = 1.0,
+                               compression: Any = "__default__"):
         """A cross-replica ShardedBucketOptimizer: this worker keeps
         optimizer state only for its ~1/world_size of the buckets and the
         update pipeline overlaps bucket collectives with bucket applies.
@@ -96,12 +103,15 @@ class TrainContext:
                                                  leaf_meta, plan_buckets)
 
         gs = self._require_grad_sync()
+        if compression == "__default__":
+            compression = gs.get("compression")
         plan = plan_buckets(leaf_meta(params),
                             bucket_bytes=gs["bucket_bytes"],
                             world_size=self.world_size)
         return ShardedBucketOptimizer(
             gs["group"], plan, self.rank, optimizer, params,
-            clip_global_norm=clip_global_norm, grad_scale=grad_scale)
+            clip_global_norm=clip_global_norm, grad_scale=grad_scale,
+            compression=compression)
 
 
 def set_context(ctx: Optional[TrainContext]):

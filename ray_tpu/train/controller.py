@@ -114,7 +114,9 @@ class TrainController:
                         f"train.grads.{self.run_dir.rsplit('/', 1)[-1]}"
                         f".r{failures}",
                         backend=scaling.grad_sync_backend,
-                        bucket_bytes=scaling.grad_sync_bucket_bytes)
+                        bucket_bytes=scaling.grad_sync_bucket_bytes,
+                        compression=getattr(scaling,
+                                            "grad_sync_compression", None))
                 self.state = "RUNNING"
                 refs = group.run(self.fn_blob, self.config, self._self_handle,
                                  self.manager.latest(), self.run_dir,

@@ -38,15 +38,28 @@ class TrainWorker:
         self._grad_sync: Optional[Dict[str, Any]] = None
 
     def setup_grad_sync(self, group_name: str, backend: str,
-                        bucket_bytes: int) -> bool:
+                        bucket_bytes: int,
+                        compression: Optional[str] = None) -> bool:
         """Join the group's bucketed grad-sync collective (and its
         ``.norm`` sibling for the sharded update's clip allgather + param
         broadcasts). The train loop reaches it through
         ``train.get_context().make_bucket_reducer`` /
-        ``make_sharded_optimizer`` (collective/bucketed.py)."""
+        ``make_sharded_optimizer`` (collective/bucketed.py).
+        ``compression`` (None/int8/fp8/bf16) is the default codec those
+        helpers hand to the reducer/optimizer (collective/quant.py)."""
         from ray_tpu import collective as col
         from ray_tpu.collective.bucketed import init_sharded_optimizer_groups
+        from ray_tpu.collective.quant import resolve_codec
 
+        # fail at setup, not mid-train: only the CPU store-actor backend
+        # implements the explicit quantized exchange (XlaGroup raises at
+        # the first bucket otherwise — the XLA tier quantizes inside
+        # compiled programs via TrainStepBundle(compression=...))
+        if resolve_codec(compression) is not None and backend != "cpu":
+            raise ValueError(
+                f"grad_sync_compression={compression!r} requires "
+                f"grad_sync_backend='cpu' (got {backend!r}); on-device "
+                f"programs use TrainStepBundle(compression=...) instead")
         init_sharded_optimizer_groups(self.world_size, self.rank,
                                       backend=backend, base_name=group_name)
         # a group is dedicated to ONE reducer (ops match by sequence
@@ -57,7 +70,8 @@ class TrainWorker:
                                   group_name=f"{group_name}.user")
         self._grad_sync = {"group": group_name, "backend": backend,
                            "bucket_bytes": int(bucket_bytes),
-                           "world_size": self.world_size}
+                           "world_size": self.world_size,
+                           "compression": compression}
         return True
 
     def get_host_info(self) -> Dict[str, Any]:
@@ -253,10 +267,12 @@ class WorkerGroup:
                     timeout=self.ready_timeout)
 
     def setup_grad_sync(self, group_name: str, backend: str = "cpu",
-                        bucket_bytes: int = 32 << 20):
+                        bucket_bytes: int = 32 << 20,
+                        compression: Optional[str] = None):
         """Initialize bucketed grad sync on every worker (driver side)."""
         ray_tpu.get([
-            w.setup_grad_sync.remote(group_name, backend, bucket_bytes)
+            w.setup_grad_sync.remote(group_name, backend, bucket_bytes,
+                                     compression)
             for w in self.workers
         ], timeout=300)
 

@@ -89,6 +89,17 @@ def _chunk_key(leaf: str, box: Box) -> str:
     return f"{leaf}|{_encode_box(box)}"
 
 
+def _decode_chunk(val: Any, chunk_entry: dict) -> np.ndarray:
+    """Undo a chunk's manifest-recorded quantized encoding (a no-op for
+    plain chunks) — pulls are transparent to the publisher's codec."""
+    enc = chunk_entry.get("enc")
+    if enc is None:
+        return np.asarray(val)
+    from ray_tpu.collective.quant import decode_array
+
+    return decode_array(np.asarray(val), enc)
+
+
 def _split_key(key: str) -> Tuple[str, Box]:
     leaf, _, flat = key.rpartition("|")
     return leaf, _decode_box(flat)
@@ -149,20 +160,32 @@ class WeightStoreActor:
                 "num_chunks": int(num_chunks), "chunks": {},
                 "committed": False, "ts": time.time(),
                 "bytes_published": 0, "bytes_pulled": 0, "num_pulls": 0,
+                "bytes_reused": 0,
             }
         return True
 
-    def put_chunks(self, version: int, blobs: Dict[str, Any]) -> int:
+    def put_chunks(self, version: int, blobs: Dict[str, Any],
+                   meta: Optional[Dict[str, dict]] = None) -> int:
         """Durable path: chunk bytes arrive as args; re-put them so the
-        refs are OWNED by this actor and outlive the publisher."""
+        refs are OWNED by this actor and outlive the publisher. ``meta``
+        carries per-key ``{"sha", "enc", "raw_nbytes"}`` — the content
+        address (delta base) and the quantized encoding (pulls decode
+        transparently)."""
         v = self._versions[version]
+        meta = meta or {}
         for key, arr in blobs.items():
             if key in v["chunks"]:
                 continue
             arr = np.asarray(arr)
+            m = meta.get(key, {})
             v["chunks"][key] = {"ref": ray_tpu.put(arr),
                                 "nbytes": arr.nbytes,
-                                "dtype": arr.dtype.str}
+                                "dtype": arr.dtype.str,
+                                "sha": m.get("sha", ""),
+                                "enc": m.get("enc"),
+                                "owned": True,
+                                "raw_nbytes": int(m.get("raw_nbytes",
+                                                        arr.nbytes))}
             v["bytes_published"] += arr.nbytes
         self._maybe_commit(version)
         return len(v["chunks"])
@@ -170,19 +193,79 @@ class WeightStoreActor:
     def register_chunks(self, version: int,
                         refs: Dict[str, List[Any]],
                         nbytes: Dict[str, int],
-                        dtypes: Dict[str, str]) -> int:
+                        dtypes: Dict[str, str],
+                        meta: Optional[Dict[str, dict]] = None) -> int:
         """Zero-copy path: the publisher ``put`` the chunks; we only hold
         the refs (valid while the publisher's owner process lives)."""
         v = self._versions[version]
+        meta = meta or {}
         for key, boxed_ref in refs.items():
             if key in v["chunks"]:
                 continue
+            m = meta.get(key, {})
             v["chunks"][key] = {"ref": boxed_ref[0],
                                 "nbytes": int(nbytes[key]),
-                                "dtype": dtypes[key]}
+                                "dtype": dtypes[key],
+                                "sha": m.get("sha", ""),
+                                "enc": m.get("enc"),
+                                "owned": False,
+                                "raw_nbytes": int(m.get("raw_nbytes",
+                                                        nbytes[key]))}
             v["bytes_published"] += int(nbytes[key])
         self._maybe_commit(version)
         return len(v["chunks"])
+
+    def chunk_shas(self, version: int) -> Dict[str, Tuple[str, Optional[str]]]:
+        """Per-chunk ``(raw-byte sha, stored encoding spec or None)`` of a
+        committed version (the delta base) — the publisher needs BOTH: a
+        sha match alone is not enough to alias a chunk whose stored bytes
+        are a lossy encoding of those raw bytes. Raises for unknown/
+        uncommitted/retired versions — the publisher falls back to a full
+        publish."""
+        v = self._versions.get(version)
+        if v is None or not v["committed"] or v.get("retired"):
+            raise KeyError(
+                f"weight store {self.name!r} version {version} is not "
+                f"available as a delta base (unknown, uncommitted or "
+                f"retired)")
+        out = {}
+        for k, c in v["chunks"].items():
+            enc = c.get("enc")
+            spec = f"{enc['codec']}:{enc['block']}" if enc else None
+            out[k] = (c.get("sha", ""), spec)
+        return out
+
+    def reuse_chunks(self, version: int, keys: List[str],
+                     from_version: int, durable: bool = False) -> int:
+        """Delta publish: alias ``keys`` of ``from_version`` into
+        ``version`` by content address — the chunk refs are shared, so no
+        bytes move and retention of the SOURCE version later cannot
+        invalidate them (the entry copies keep the refs alive). A
+        ``durable`` target must OWN every chunk: refs borrowed from a
+        zero-copy (non-durable) base are re-put here, or the durable
+        guarantee would silently die with the base's publisher process."""
+        v = self._versions[version]
+        src = self._versions.get(from_version)
+        if src is None or src.get("retired"):
+            raise KeyError(f"delta base version {from_version} is gone")
+        reused = 0
+        for key in keys:
+            if key in v["chunks"]:
+                continue
+            c = src["chunks"].get(key)
+            if c is None:
+                raise KeyError(
+                    f"delta base version {from_version} has no chunk "
+                    f"{key!r}")
+            ent = dict(c)
+            if durable and not c.get("owned"):
+                ent["ref"] = ray_tpu.put(np.asarray(ray_tpu.get(c["ref"])))
+                ent["owned"] = True
+            v["chunks"][key] = ent
+            v["bytes_reused"] += int(c.get("raw_nbytes", c["nbytes"]))
+            reused += 1
+        self._maybe_commit(version)
+        return reused
 
     def _maybe_commit(self, version: int):
         v = self._versions[version]
@@ -229,7 +312,8 @@ class WeightStoreActor:
             "skeleton": v["skeleton"],
             "spec": v["spec"],
             "chunks": {k: {"ref": [c["ref"]], "nbytes": c["nbytes"],
-                           "dtype": c["dtype"]}
+                           "dtype": c["dtype"], "sha": c.get("sha", ""),
+                           "enc": c.get("enc")}
                        for k, c in v["chunks"].items()},
         }
 
@@ -248,9 +332,10 @@ class WeightStoreActor:
             "name": self.name,
             "latest": self._latest,
             "versions": {
-                str(ver): {k: v[k] for k in
+                str(ver): {k: v.get(k, 0) for k in
                            ("committed", "ts", "num_chunks",
-                            "bytes_published", "bytes_pulled", "num_pulls")}
+                            "bytes_published", "bytes_pulled", "num_pulls",
+                            "bytes_reused")}
                 for ver, v in sorted(self._versions.items())
             },
         }
@@ -323,10 +408,24 @@ class WeightStore:
 
     def publish(self, tree: Any, *, version: Optional[int] = None,
                 spec: Optional[ShardedTreeSpec] = None,
-                durable: bool = False, timeout: float = 300.0) -> int:
+                durable: bool = False, timeout: float = 300.0,
+                delta_from: Optional[int] = None,
+                compression: Any = None) -> int:
         """Publish a FULL tree from this process (the single-source case:
         a learner broadcasting to env-runners, a driver seeding replicas).
-        For mesh-sharded publishers use :func:`publish_host_shards`."""
+        For mesh-sharded publishers use :func:`publish_host_shards`.
+
+        ``delta_from=prev_version`` hashes every leaf chunk against the
+        previous manifest and ships ONLY the changed ones — unchanged
+        leaves alias the prior version's chunks by content address (no
+        bytes move; pulls are byte-exact regardless). A vanished/retired
+        base falls back to a full publish, logged, never an error.
+
+        ``compression`` ("int8"/"fp8"/"bf16", collective/quant.py)
+        block-quantizes the chunk payloads on the wire; the encoding is
+        recorded per chunk in the manifest and ``pull``/``pull_shards``
+        decode transparently (lossy — delta hashing still uses the RAW
+        bytes, so delta and quantized publishes compose)."""
         skeleton, leaves = flatten_tree(tree)
         arrays = {p: np.asarray(v) for p, v in leaves.items()}
         if spec is None:
@@ -337,30 +436,103 @@ class WeightStore:
                   for p, a in arrays.items()}
         self._publish_chunks(version, skeleton, spec, chunks,
                              num_chunks=len(chunks), durable=durable,
-                             timeout=timeout)
+                             timeout=timeout, delta_from=delta_from,
+                             compression=compression)
         return version
 
     def _publish_chunks(self, version: int, skeleton: Any,
                         spec: ShardedTreeSpec, chunks: Dict[str, np.ndarray],
-                        num_chunks: int, durable: bool, timeout: float):
+                        num_chunks: int, durable: bool, timeout: float,
+                        delta_from: Optional[int] = None,
+                        compression: Any = None):
+        import hashlib
+
+        from ray_tpu.collective.quant import encode_array, resolve_codec
         from ray_tpu.util import tracing
 
+        codec = resolve_codec(compression)
         t0 = time.perf_counter()
         with tracing.profile("weights.publish", category="weights",
                              store=self.name, version=version):
+            # hash the array buffer directly — tobytes() would copy every
+            # chunk; ascontiguousarray is a no-op for the (typical)
+            # already-contiguous case. Hashing on EVERY publish is what
+            # lets any version serve as a later delta base. The dtype
+            # prefixes the digest: identical bytes under a different
+            # dtype are a DIFFERENT chunk (aliasing one would value-cast
+            # on pull).
+            def _sha(a: np.ndarray) -> str:
+                h = hashlib.sha256(a.dtype.str.encode())
+                h.update(np.ascontiguousarray(a))
+                return h.hexdigest()
+
+            shas = {k: _sha(a) for k, a in chunks.items()}
             ray_tpu.get(self._actor.begin.remote(
                 version, skeleton, _spec_payload(spec), num_chunks),
                 timeout=timeout)
+            todo = dict(chunks)
+            if delta_from is not None:
+                # any base-unavailable condition (retired by retention,
+                # unknown version, a race against retirement mid-reuse —
+                # surfaced as a wrapped TaskError) degrades to a FULL
+                # publish: correctness never depends on the delta base
+                try:
+                    prev = ray_tpu.get(
+                        self._actor.chunk_shas.remote(delta_from),
+                        timeout=timeout)
+                    cspec = codec.spec() if codec is not None else None
+
+                    def _reusable(k: str) -> bool:
+                        ent = prev.get(k)
+                        if ent is None or ent[0] != shas[k]:
+                            return False
+                        if ent[1] is None:
+                            return True  # base chunk is exact raw bytes
+                        # the base chunk is a LOSSY encoding of the same
+                        # raw bytes: aliasing it is only correct when this
+                        # publish would encode the chunk identically (the
+                        # codecs are deterministic) — never under a
+                        # different codec or an exact (compression=None)
+                        # publish, whose pulls must stay byte-exact
+                        return (ent[1] == cspec and
+                                np.issubdtype(chunks[k].dtype,
+                                              np.floating))
+
+                    unchanged = [k for k in shas if _reusable(k)]
+                    if unchanged:
+                        ray_tpu.get(self._actor.reuse_chunks.remote(
+                            version, unchanged, delta_from, durable),
+                            timeout=timeout)
+                        for k in unchanged:
+                            todo.pop(k)
+                except Exception as e:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "weight store %s: delta base v%s unavailable "
+                        "(%s); publishing v%s in full", self.name,
+                        delta_from, e, version)
+            payloads: Dict[str, np.ndarray] = {}
+            meta: Dict[str, dict] = {}
+            for k, a in todo.items():
+                m = {"sha": shas[k], "enc": None, "raw_nbytes": int(a.nbytes)}
+                if codec is not None and np.issubdtype(a.dtype, np.floating):
+                    wire, enc = encode_array(a, codec)
+                    payloads[k] = wire
+                    m["enc"] = enc
+                else:
+                    payloads[k] = a
+                meta[k] = m
             if durable:
                 # ship bytes; the store re-puts so refs survive this process
-                ray_tpu.get(self._actor.put_chunks.remote(version, chunks),
-                            timeout=timeout)
+                ray_tpu.get(self._actor.put_chunks.remote(
+                    version, payloads, meta), timeout=timeout)
             else:
-                refs = {k: [ray_tpu.put(a)] for k, a in chunks.items()}
-                nbytes = {k: int(a.nbytes) for k, a in chunks.items()}
-                dtypes = {k: a.dtype.str for k, a in chunks.items()}
+                refs = {k: [ray_tpu.put(a)] for k, a in payloads.items()}
+                nbytes = {k: int(a.nbytes) for k, a in payloads.items()}
+                dtypes = {k: a.dtype.str for k, a in payloads.items()}
                 ray_tpu.get(self._actor.register_chunks.remote(
-                    version, refs, nbytes, dtypes), timeout=timeout)
+                    version, refs, nbytes, dtypes, meta), timeout=timeout)
         _obs()["publish"].observe(time.perf_counter() - t0)
 
     # -- consume -------------------------------------------------------
@@ -399,8 +571,8 @@ class WeightStore:
             for leaf, (shape, dtype) in spec.meta.items():
                 out = np.empty(shape, dtype=np.dtype(dtype))
                 for box, c in by_leaf.get(leaf, ()):
-                    val = np.asarray(ray_tpu.get(c["ref"][0],
-                                                 timeout=timeout))
+                    val = _decode_chunk(
+                        ray_tpu.get(c["ref"][0], timeout=timeout), c)
                     out[box_slices(box)] = val.reshape(
                         tuple(b - a for a, b in box))
                     pulled += c["nbytes"]
@@ -446,8 +618,8 @@ class WeightStore:
                         key = _chunk_key(leaf, cbox)
                         chunk = cache.get(key)
                         if chunk is None:
-                            chunk = np.asarray(
-                                ray_tpu.get(c["ref"][0], timeout=timeout)
+                            chunk = _decode_chunk(
+                                ray_tpu.get(c["ref"][0], timeout=timeout), c
                             ).reshape(tuple(b - a for a, b in cbox))
                             cache[key] = chunk
                             pulled += c["nbytes"]

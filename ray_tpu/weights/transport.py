@@ -75,7 +75,9 @@ def publish_host_shards(store: WeightStore, version: int,
                         *, skeleton: Any = None,
                         dst_spec: Optional[ShardedTreeSpec] = None,
                         durable: bool = False,
-                        timeout: float = 300.0) -> int:
+                        timeout: float = 300.0,
+                        delta_from: Optional[int] = None,
+                        compression=None) -> int:
     """One source host's side of a mesh publish.
 
     Every host of ``spec.mesh`` calls this with the same ``version``; the
@@ -83,6 +85,10 @@ def publish_host_shards(store: WeightStore, version: int,
     plan's exact intersection chunks are published (minimal bytes for a
     known destination); without it, the host's unique shard boxes are
     published as-is (subscriber-agnostic; consumers slice on pull).
+
+    ``delta_from``/``compression`` behave as in :meth:`WeightStore.publish`
+    (each host deltas its own chunk set against the base manifest;
+    quantized encodings land per chunk in the shared manifest).
 
     Returns the number of chunks this host contributed.
     """
@@ -116,7 +122,8 @@ def publish_host_shards(store: WeightStore, version: int,
                        for leaf in spec.meta)
     store._publish_chunks(version, skeleton, spec, mine,
                           num_chunks=expected, durable=durable,
-                          timeout=timeout)
+                          timeout=timeout, delta_from=delta_from,
+                          compression=compression)
     return len(mine)
 
 

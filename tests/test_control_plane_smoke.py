@@ -100,6 +100,12 @@ def test_submit_fast_path_regression_guards():
         assert core._submit_stats["spec_frames"] == frames0, (
             frames0, core._submit_stats)
         assert core._submit_stats["fast_path"] >= 100
+        # (3) the serialization scratch pool absorbs warm submits: after
+        # the first submit sized the per-thread buffer, a same-shape burst
+        # re-packs into it instead of allocating per call
+        stats = core.submit_stats()
+        assert stats["pack_pool_hits"] >= 95, stats
+        assert stats["pack_pool_hits"] > 10 * stats["pack_pool_misses"]
         # semantics preserved through the fast path: dependency chains,
         # multiple returns, and errors still behave
         @ray_tpu.remote(num_cpus=0.1, num_returns=2)

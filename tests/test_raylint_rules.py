@@ -1594,3 +1594,50 @@ def test_obs001_bucket_metrics_negative_pr12_shapes():
                 pass
     """, rules=["OBS001"])
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# OBS001 — PR 14 quantized-comms instruments (quant bytes/encode metrics
+# stay prefixed + described; codec/wire facts ride span TAGS, not names)
+# ---------------------------------------------------------------------------
+
+
+def test_obs001_quant_metrics_positive():
+    findings = lint("""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        saved = Counter("quant_bytes_saved", "wire bytes saved")
+        enc = Histogram("ray_tpu.train.quant_encode_seconds")
+
+        def reduce_quantized(codec):
+            with tracing.profile(f"train.bucket_allreduce.{codec}"):
+                pass
+    """, rules=["OBS001"])
+    assert rules_of(findings) == ["OBS001"] * 3
+    assert "ray_tpu_" in findings[0].message      # unprefixed counter
+    assert "description" in findings[1].message   # undescribed histogram
+    assert "static string" in findings[2].message  # codec in the span name
+
+
+def test_obs001_quant_metrics_negative_pr14_shapes():
+    # the shapes the quantized tier actually ships: described
+    # ray_tpu.train.quant_* instruments, codec + wire bytes as span tags
+    findings = lint("""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        saved = Counter("ray_tpu.train.quant_bytes_saved",
+                        "wire bytes saved by the quantized collective "
+                        "tier vs fp32")
+        enc = Histogram("ray_tpu.train.quant_encode_seconds",
+                        "encode/decode CPU time of one quantized payload",
+                        boundaries=[0.0001, 0.001, 0.01])
+
+        def reduce_quantized(idx, codec, nbytes):
+            with tracing.profile("train.bucket_allreduce", category="train",
+                                 bucket=idx, compression=codec,
+                                 wire_bytes=nbytes):
+                pass
+    """, rules=["OBS001"])
+    assert findings == []

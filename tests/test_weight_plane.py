@@ -627,3 +627,143 @@ def test_jax_reshard_transition_no_rematerialization(cluster):
     spill += [str(w.message) for w in caught
               if "rematerialization" in str(w.message).lower()]
     assert not spill, spill
+
+
+# ---------------------------------------------------------------------------
+# delta + quantized publishes (the compression tier of the weight plane)
+# ---------------------------------------------------------------------------
+
+
+def _delta_tree(rng, n_leaves=8, rows=128):
+    return {f"l{i}": rng.normal(size=(rows, 64)).astype(np.float32)
+            for i in range(n_leaves)}
+
+
+def test_delta_publish_byte_exact_and_under_half_bytes(cluster):
+    """A small-update delta publish ships only the changed chunks
+    (< 50% of full-publish bytes) and pulls stay BYTE-exact against the
+    logical tree — unchanged leaves alias the base version's chunks by
+    content address."""
+    rng = np.random.default_rng(0)
+    tree = _delta_tree(rng)
+    store = WeightStore("w_delta")
+    v1 = store.publish(tree, durable=True)
+    tree2 = dict(tree)
+    tree2["l3"] = tree["l3"] + 1.0  # 1 of 8 leaves changed
+    v2 = store.publish(tree2, durable=True, delta_from=v1)
+    pulled = store.pull(v2)
+    for k in tree2:
+        np.testing.assert_array_equal(pulled[k], tree2[k])
+    vs = store.stats()["versions"]
+    full, delta = vs[str(v1)], vs[str(v2)]
+    assert delta["bytes_published"] < 0.5 * full["bytes_published"], \
+        (full, delta)
+    assert delta["bytes_reused"] == 7 * tree["l0"].nbytes
+
+
+def test_chained_deltas_survive_retention(cluster):
+    """v3/v4 delta off their predecessors; retention (keep=2) retires the
+    intermediate versions, but the aliased chunk entries keep the refs
+    alive — the newest delta version still pulls byte-exact."""
+    rng = np.random.default_rng(1)
+    tree = _delta_tree(rng)
+    store = WeightStore("w_chain")
+    v = store.publish(tree, durable=True)
+    for i in range(3):  # three chained deltas -> the base retires
+        tree = dict(tree)
+        tree[f"l{i}"] = tree[f"l{i}"] * 2.0 + i
+        v = store.publish(tree, durable=True, delta_from=v)
+    pulled = store.pull(v)
+    for k in tree:
+        np.testing.assert_array_equal(pulled[k], tree[k])
+    # the earliest version really is retired (not silently kept)
+    vs = sorted(int(x) for x in store.stats()["versions"])
+    with pytest.raises(Exception):
+        store.manifest(vs[0])
+
+
+def test_delta_base_vanished_falls_back_to_full(cluster):
+    rng = np.random.default_rng(2)
+    tree = _delta_tree(rng, n_leaves=4)
+    store = WeightStore("w_fall")
+    for _ in range(4):  # roll versions so v1 retires
+        store.publish(tree, durable=True)
+    v = store.publish(tree, durable=True, delta_from=1)  # retired base
+    vs = store.stats()["versions"][str(v)]
+    assert vs["bytes_reused"] == 0  # full publish, no silent aliasing
+    pulled = store.pull(v)
+    for k in tree:
+        np.testing.assert_array_equal(pulled[k], tree[k])
+
+
+def test_quantized_publish_pull_and_compose_with_delta(cluster):
+    """Quantized chunk encoding: int8 publish ships <30% of the raw
+    bytes, pulls (full AND sharded) transparently dequantize, and an
+    unchanged delta on top of a quantized base reuses every chunk (delta
+    hashing keys on RAW bytes, so the tiers compose)."""
+    rng = np.random.default_rng(3)
+    tree = _delta_tree(rng)
+    raw = sum(a.nbytes for a in tree.values())
+    store = WeightStore("w_quant")
+    v1 = store.publish(tree, durable=True, compression="int8")
+    p1 = store.pull(v1)
+    for k in tree:
+        rel = np.abs(p1[k] - tree[k]).max() / np.abs(tree[k]).max()
+        assert rel < 0.02, (k, rel)
+    vs = store.stats()["versions"]
+    assert vs[str(v1)]["bytes_published"] < 0.3 * raw
+    # sharded pull decodes the same bytes
+    dst_mesh = MeshSpec((2,), ("data",), ("h0", "h1"))
+    dst = ShardedTreeSpec.from_tree(tree, dst_mesh, default_part=("data",))
+    shards = store.pull_shards(dst, "h0", v1)
+    box = next(iter(shards["l0"]))
+    np.testing.assert_array_equal(shards["l0"][box], p1["l0"][:64])
+    # delta on an unchanged tree: zero new bytes, pulls match the base
+    v2 = store.publish(tree, durable=True, delta_from=v1,
+                       compression="int8")
+    assert store.stats()["versions"][str(v2)]["bytes_published"] == 0
+    p2 = store.pull(v2)
+    for k in tree:
+        np.testing.assert_array_equal(p2[k], p1[k])
+
+
+def test_plain_publish_unchanged_by_compression_tier(cluster):
+    """Regression guard: the default publish writes NO encodings into the
+    manifest and pulls are bitwise-identical — the compression tier is
+    strictly opt-in."""
+    rng = np.random.default_rng(4)
+    tree = _delta_tree(rng, n_leaves=3)
+    store = WeightStore("w_plain")
+    v = store.publish(tree, durable=True)
+    man = store.manifest(v)
+    for c in man["chunks"].values():
+        assert c["enc"] is None
+        assert c["sha"]  # content address recorded for future deltas
+    pulled = store.pull(v)
+    for k in tree:
+        np.testing.assert_array_equal(pulled[k], tree[k])
+
+
+def test_learner_group_delta_quantized_publish(cluster):
+    """The rl publish path: LearnerGroup.publish_weights(delta=True)
+    publishes against the learner's previous version; with compression
+    the env-runner-facing pull dequantizes transparently."""
+    from ray_tpu.rl.learner_group import LearnerGroup
+
+    group = LearnerGroup(_toy_factory, num_learners=2)
+    try:
+        store = WeightStore("w_lg")
+        v1 = group.publish_weights("w_lg", durable=True, delta=True)
+        v2 = group.publish_weights("w_lg", durable=True, delta=True)
+        vs = store.stats()["versions"]
+        # params unchanged between publishes -> the second is all-reuse
+        assert vs[str(v2)]["bytes_published"] == 0
+        assert vs[str(v2)]["bytes_reused"] > 0
+        t1, t2 = store.pull(v1), store.pull(v2)
+        for k in t1:
+            np.testing.assert_array_equal(t1[k], t2[k])
+        v3 = group.publish_weights("w_lg", durable=True, delta=True,
+                                   compression="int8")
+        assert store.latest() == v3
+    finally:
+        group.shutdown()

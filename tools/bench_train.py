@@ -113,21 +113,26 @@ def bench_step_flavors(bucket_bytes: int, steps: int = 10,
     return out
 
 
-def bench_reducer(mb: int = 8) -> dict:
+def bench_reducer(mb: int = 8, compression=None) -> dict:
     """AsyncBucketReducer throughput on a world-size-1 loopback group
-    (prices the pack/unpack + thread handoff floor, no network)."""
+    (prices the pack/unpack + thread handoff floor, no network). With
+    ``compression`` the same tree rides the quantized path — the wire
+    accounting (``*_wire_reduction_x``) is the fp32-vs-quantized byte
+    ratio the ISSUE acceptance bar reads."""
     import numpy as np
 
     from ray_tpu import collective as col
     from ray_tpu.collective.bucketed import (AsyncBucketReducer, leaf_meta,
                                              plan_buckets)
 
+    tag = compression or "fp32"
+    group = f"bench_train.reducer.{tag}"
     tree = {f"leaf{i}": np.random.default_rng(i).normal(
         size=(mb * 1024, 128)).astype(np.float32) for i in range(2)}
-    col.init_collective_group(1, 0, backend="cpu",
-                              group_name="bench_train.reducer")
+    col.init_collective_group(1, 0, backend="cpu", group_name=group)
     plan = plan_buckets(leaf_meta(tree), bucket_bytes=4 << 20, world_size=1)
-    red = AsyncBucketReducer("bench_train.reducer", plan)
+    red = AsyncBucketReducer(group, plan, compression=compression)
+    prefix = "reducer" if compression is None else f"reducer_{compression}"
     try:
         red.reduce_tree(tree)  # warm
         nbytes = sum(a.nbytes for a in tree.values())
@@ -138,9 +143,17 @@ def bench_reducer(mb: int = 8) -> dict:
         dt = (time.perf_counter() - t0) / iters
     finally:
         red.shutdown()
-        col.destroy_collective_group("bench_train.reducer")
-    return {"reducer_allreduce_mb_s": nbytes / dt / 1e6,
-            "reducer_buckets": plan.num_buckets}
+        col.destroy_collective_group(group)
+    out = {f"{prefix}_allreduce_mb_s": nbytes / dt / 1e6,
+           f"{prefix}_buckets": plan.num_buckets}
+    if compression is not None:
+        ws = red.wire_stats()
+        out[f"{prefix}_wire_bytes"] = ws["bytes_wire"]
+        out[f"{prefix}_fp32_bytes"] = ws["bytes_fp32_equiv"]
+        out[f"{prefix}_wire_reduction_x"] = ws.get("wire_reduction_x", 0.0)
+        out[f"{prefix}_encode_s_per_iter"] = round(
+            ws["encode_s"] / (iters + 1), 5)
+    return out
 
 
 def main() -> int:
@@ -149,6 +162,9 @@ def main() -> int:
     parser.add_argument("--bucket-bytes", type=int, default=1 << 20)
     parser.add_argument("--steps", type=int, default=10)
     parser.add_argument("--skip-reducer", action="store_true")
+    parser.add_argument("--compression", default="int8",
+                        help="codec for the quantized-reducer pricing "
+                             "(int8/fp8/bf16; 'none' skips it)")
     args = parser.parse_args()
 
     t0 = time.time()
@@ -161,6 +177,8 @@ def main() -> int:
             ray_tpu.init(num_cpus=2)
         try:
             result.update(bench_reducer())
+            if args.compression and args.compression != "none":
+                result.update(bench_reducer(compression=args.compression))
         finally:
             if started:
                 ray_tpu.shutdown()

@@ -9,10 +9,15 @@ Measures the three flows the weight plane exists for:
   version through the store (fan-out throughput, aggregate MB/s).
 - ``reshard``: 4 source actors publish planned chunks, 2 destination actors
   pull their resharded shards (end-to-end MB/s for the cross-mesh path).
+- ``compression`` (``--compression int8``): quantized publish/allreduce wire
+  bytes vs fp32 (the EQuARX tier — codec bytes ratio must clear ~4x).
+- ``delta`` (``--delta``): small-update delta publish bytes vs a full
+  publish, with a byte-exact pull check.
 
 Usage::
 
     python tools/bench_weights.py [--payload-mb 8] [--runners 8]
+                                  [--compression int8] [--delta]
 
 Prints one JSON list of ``{"name": ..., "value": ..., "unit": ...}`` rows
 (the microbenchmark idiom of ``_private/microbenchmark.py``).
@@ -36,7 +41,121 @@ def _payload_tree(payload_mb: float):
     return {"w": np.arange(n, dtype=np.float32).reshape(8, n // 8)}
 
 
-def main(payload_mb: float = 8.0, runners: int = 8) -> list:
+def bench_compression(payload_mb: float, compression: str) -> list:
+    """Quantized-tier pricing: (a) bucket-allreduce wire bytes through the
+    2-rank quantized collective vs fp32 at equal tree size, (b) quantized
+    store publish bytes + pull error."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.collective import quant
+    from ray_tpu.weights import WeightStore
+
+    codec = quant.resolve_codec(compression)
+    if codec is None:  # --compression none/off: nothing to price
+        return []
+    tree = _payload_tree(payload_mb)
+    raw = tree["w"].nbytes
+    rows = []
+
+    @ray_tpu.remote(num_cpus=0.5)
+    class Rank:
+        def __init__(self, rank, world, comp):
+            from ray_tpu import collective as col
+
+            col.init_collective_group(world, rank, backend="cpu",
+                                      group_name="bench_w.quant")
+            self.rank, self.world, self.comp = rank, world, comp
+
+        def reduce(self, payload_mb):
+            from ray_tpu.collective.bucketed import (AsyncBucketReducer,
+                                                     leaf_meta,
+                                                     plan_buckets)
+
+            tree = _payload_tree(payload_mb)
+            plan = plan_buckets(leaf_meta(tree), bucket_bytes=4 << 20,
+                                world_size=self.world)
+            red = AsyncBucketReducer("bench_w.quant", plan,
+                                     compression=self.comp)
+            try:
+                t0 = _time.perf_counter()
+                red.reduce_tree(tree)
+                dt = _time.perf_counter() - t0
+                return red.wire_stats(), dt
+            finally:
+                red.shutdown()
+
+    ranks = [Rank.remote(r, 2, compression) for r in range(2)]
+    (stats, dt), _ = ray_tpu.get(
+        [a.reduce.remote(payload_mb) for a in ranks], timeout=600)
+    rows += [
+        {"name": "quant_allreduce_fp32_bytes",
+         "value": stats["bytes_fp32_equiv"], "unit": "bytes"},
+        {"name": "quant_allreduce_wire_bytes",
+         "value": stats["bytes_wire"], "unit": "bytes"},
+        {"name": "quant_allreduce_reduction",
+         "value": stats.get("wire_reduction_x", 0.0), "unit": "x"},
+        {"name": "quant_allreduce_s", "value": round(dt, 4), "unit": "s"},
+    ]
+    for a in ranks:
+        ray_tpu.kill(a)
+
+    store = WeightStore(f"bench_quant_{compression}")
+    v = store.publish(tree, durable=True, compression=compression)
+    pulled = store.pull(v)
+    import numpy as _np
+
+    err = float(_np.abs(pulled["w"] - tree["w"]).max()
+                / _np.abs(tree["w"]).max())
+    pub = store.stats()["versions"][str(v)]["bytes_published"]
+    rows += [
+        {"name": "quant_publish_bytes", "value": pub, "unit": "bytes"},
+        {"name": "quant_publish_raw_bytes", "value": raw, "unit": "bytes"},
+        {"name": "quant_publish_reduction", "value": round(raw / pub, 2),
+         "unit": "x"},
+        {"name": "quant_pull_rel_err", "value": round(err, 5), "unit": "x"},
+        {"name": "quant_codec_bytes_per_el",
+         "value": round(codec.bytes_per_element, 4), "unit": "B"},
+    ]
+    return rows
+
+
+def bench_delta(payload_mb: float, leaves: int = 16,
+                changed: int = 2) -> list:
+    """Delta-publish pricing: change ``changed`` of ``leaves`` leaves and
+    compare published bytes vs the full publish; pulls must be byte-exact."""
+    import numpy as _np
+
+    from ray_tpu.weights import WeightStore
+
+    n = max(int(payload_mb * 1024 * 1024 // 4 // leaves), 64)
+    rng = _np.random.default_rng(0)
+    tree = {f"l{i}": rng.normal(size=n).astype(_np.float32)
+            for i in range(leaves)}
+    store = WeightStore("bench_delta")
+    v1 = store.publish(tree, durable=True)
+    tree2 = dict(tree)
+    for i in range(changed):
+        tree2[f"l{i}"] = tree[f"l{i}"] + 1.0
+    v2 = store.publish(tree2, durable=True, delta_from=v1)
+    pulled = store.pull(v2)
+    exact = all(_np.array_equal(pulled[k], tree2[k]) for k in tree2)
+    vs = store.stats()["versions"]
+    full = vs[str(v1)]["bytes_published"]
+    delta = vs[str(v2)]["bytes_published"]
+    return [
+        {"name": "delta_full_publish_bytes", "value": full, "unit": "bytes"},
+        {"name": "delta_publish_bytes", "value": delta, "unit": "bytes"},
+        {"name": "delta_fraction", "value": round(delta / full, 4),
+         "unit": "x"},
+        {"name": "delta_bytes_reused", "value": vs[str(v2)]["bytes_reused"],
+         "unit": "bytes"},
+        {"name": "delta_pull_byte_exact", "value": int(exact), "unit": "bool"},
+    ]
+
+
+def main(payload_mb: float = 8.0, runners: int = 8,
+         compression: str = "", delta: bool = False) -> list:
     import ray_tpu
     from ray_tpu.weights import (MeshSpec, ShardedTreeSpec, WeightStore,
                                  local_shards_of, plan_reshard,
@@ -138,6 +257,11 @@ def main(payload_mb: float = 8.0, runners: int = 8) -> list:
     ]
     for a in srcs + dsts:
         ray_tpu.kill(a)
+
+    if compression:
+        rows += bench_compression(payload_mb, compression)
+    if delta:
+        rows += bench_delta(payload_mb)
     return rows
 
 
@@ -145,10 +269,14 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--payload-mb", type=float, default=8.0)
     parser.add_argument("--runners", type=int, default=8)
+    parser.add_argument("--compression", default="",
+                        help="price the quantized tier (int8/fp8/bf16)")
+    parser.add_argument("--delta", action="store_true",
+                        help="price the delta-publish tier")
     args = parser.parse_args()
     import ray_tpu
 
-    rows = main(args.payload_mb, args.runners)
+    rows = main(args.payload_mb, args.runners, args.compression, args.delta)
     print(json.dumps(rows))
     ray_tpu.shutdown()
     sys.exit(0)
