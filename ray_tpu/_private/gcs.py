@@ -369,6 +369,7 @@ class ShardedTaskEvents:
     def _merge_loop(self):
         while True:
             self._wake.wait(timeout=0.5)
+            # raylint: disable=RCE002 _wake is a threading.Event — itself the synchronization primitive; .clear() is misread as a container mutation, and a lost wakeup is bounded by the 0.5s poll
             self._wake.clear()
             try:
                 self._drain_queues()
@@ -386,6 +387,7 @@ class ShardedTaskEvents:
                 while q and len(batch) < 1024:
                     batch.append(q.popleft())
                 self.shards[i].add_events(batch)
+                # raylint: disable=RCE001 _drain_queues runs inline on a caller only when the merge thread is not alive (flush_sync checks); live-thread callers hand off through _reads instead, so two contexts never drain concurrently
                 self.batches += 1
         while self._reporter_drops:
             self.shards[0].add_events([], self._reporter_drops.popleft())

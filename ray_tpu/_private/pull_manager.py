@@ -91,6 +91,7 @@ class PullQueue:
     def release(self, oid: bytes):
         e = self._entries.pop(oid, None)
         if e is not None and e["state"] == "transferring":
+            # raylint: disable=RCE001 release() is only called from the raylet's async pull path (same loop as admit); the cross-object call edge is beyond the resolver, so its context defaults to the caller thread
             self._in_flight -= 1
         self._kick()
 

@@ -231,6 +231,7 @@ class Raylet:
         # ("views" batch); entries are idempotent last-writer-wins, so the
         # legacy single-entry form stays accepted
         for m in msg["views"] if "views" in msg else (msg,):
+            # raylint: disable=RCE001 _on_gcs_push is registered as the client's push callback and always fires on this raylet's loop; the dynamic registration is invisible to the call graph, so it defaults to the caller thread
             self.cluster_view[m["node_id"]] = {
                 "address": m["address"], "available": m["available"],
                 "total": m["total"], "labels": m["labels"],

@@ -188,6 +188,7 @@ class FileStoreClient(StoreClient):
         if now - self._last_fsync >= self.FSYNC_INTERVAL_S:
             os.fsync(self._journal.fileno())
             self._last_fsync = now
+        # raylint: disable=RCE001 the other write site (_load) runs once inside __init__ before the server accepts connections — construction happens-before every locked _append
         self._journal_records += 1
         if self._journal_records >= self.COMPACT_EVERY:
             self._compact_locked()

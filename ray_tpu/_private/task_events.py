@@ -52,16 +52,23 @@ _enabled: Optional[bool] = None
 
 
 def enabled() -> bool:
+    # double-checked under _lock: the unlocked fast path never writes, so
+    # a racing set_enabled() override cannot be overwritten by a stale
+    # env read (the old unlocked check-then-act lost exactly that update)
     global _enabled
     if _enabled is None:
-        _enabled = os.environ.get("RAY_TPU_TASK_EVENTS", "1") not in ("0", "false")
+        with _lock:
+            if _enabled is None:
+                _enabled = os.environ.get(
+                    "RAY_TPU_TASK_EVENTS", "1") not in ("0", "false")
     return _enabled
 
 
 def set_enabled(value: Optional[bool]):
     """Override the env flag (None = re-read it); used by tests/benchmarks."""
     global _enabled
-    _enabled = value
+    with _lock:
+        _enabled = value
 
 
 def _append(entry) -> None:

@@ -46,6 +46,7 @@ class WireError(TypeError):
 # Registry
 # ---------------------------------------------------------------------------
 
+# raylint: disable=FRK001 import-time append-only registry, identical in parent and child: register_* runs at module import, so the zygote image and a fresh process hold the same entries and a reset would only re-register them
 _STRUCTS: Dict[str, tuple] = {}  # tag -> (cls, fields, decode)
 _STRUCT_TAGS: Dict[Type, str] = {}
 _IDS: Dict[str, Type] = {}

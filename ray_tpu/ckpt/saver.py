@@ -258,6 +258,7 @@ class CheckpointSaver:
             _obs()["backpressure"].observe(time.monotonic() - t0)
         self._inflight = None
         if self._error is not None:
+            # raylint: disable=RCE001 the writer thread's _error/_last_manifest stores are ordered by the t.join()/is_alive() above (Thread.join happens-before); taking self._lock in _run instead would deadlock against this locked join
             err, self._error = self._error, None
             raise RuntimeError(f"background checkpoint save failed: {err!r}") \
                 from err

@@ -183,11 +183,18 @@ class _Replica:
         else:
             self._callable = functools.partial(target, *args, **kwargs) \
                 if args or kwargs else target
+        import threading as _th
+
         self._num_ongoing = 0
         # high-water mark since the autoscaler's last poll: a short burst
         # that starts AND drains between two 0.5s samples is still load —
         # instantaneous sampling alone is blind to it
         self._peak_ongoing = 0
+        # request accounting runs on the replica's event loop, but
+        # take_ongoing_peak() is a sync actor method on a pool thread:
+        # its read-reset is a two-step RMW, so without a lock a burst
+        # peaking between the read and the reset is silently dropped
+        self._stats_lock = _th.Lock()
 
     async def handle_request(self, method_name: str, args_blob: bytes):
         import contextvars as _cv
@@ -207,8 +214,9 @@ class _Replica:
             tracing.record_span("serve.queue", submit_ts, now,
                                 category="serve")
         token = _set_current_model_id(model_id)
-        self._num_ongoing += 1
-        self._peak_ongoing = max(self._peak_ongoing, self._num_ongoing)
+        with self._stats_lock:
+            self._num_ongoing += 1
+            self._peak_ongoing = max(self._peak_ongoing, self._num_ongoing)
         t_exec = time.perf_counter()
         try:
             if method_name == "__call__":
@@ -235,7 +243,8 @@ class _Replica:
             obs = _obs()
             obs["execute"].observe(time.perf_counter() - t_exec)
             obs["requests"].inc()
-            self._num_ongoing -= 1
+            with self._stats_lock:
+                self._num_ongoing -= 1
 
     async def handle_request_streaming(self, method_name: str,
                                        args_blob: bytes):
@@ -262,8 +271,9 @@ class _Replica:
             fn = self._callable
         else:
             fn = getattr(self._callable, method_name)
-        self._num_ongoing += 1
-        self._peak_ongoing = max(self._peak_ongoing, self._num_ongoing)
+        with self._stats_lock:
+            self._num_ongoing += 1
+            self._peak_ongoing = max(self._peak_ongoing, self._num_ongoing)
         try:
             if inspect.isasyncgenfunction(fn):
                 async for chunk in fn(*args, **kwargs):
@@ -283,7 +293,8 @@ class _Replica:
             else:
                 yield out
         finally:
-            self._num_ongoing -= 1
+            with self._stats_lock:
+                self._num_ongoing -= 1
 
     def num_ongoing(self) -> int:
         return self._num_ongoing
@@ -292,8 +303,9 @@ class _Replica:
         """Autoscaler sample: the highest concurrent-request count since
         the previous call (reset to the current level). Peak-based
         sampling sees bursts that fully drain between two polls."""
-        peak = max(self._peak_ongoing, self._num_ongoing)
-        self._peak_ongoing = self._num_ongoing
+        with self._stats_lock:
+            peak = max(self._peak_ongoing, self._num_ongoing)
+            self._peak_ongoing = self._num_ongoing
         return peak
 
     def drain(self) -> int:

@@ -116,6 +116,16 @@ def test_submit_fast_path_regression_guards():
         chained = f.remote(b)
         assert ray_tpu.get([a, chained], timeout=120) == [3, 31]
 
+        # (4) wait() partitions readiness via the per-poll set
+        # intersection (not per-ref store probes) — the counter proves the
+        # vectorized path actually engaged, and semantics hold
+        polls0 = core._submit_stats["wait_vector_polls"]
+        more = [f.remote(i) for i in range(20)]
+        done, not_done = ray_tpu.wait(more, num_returns=20, timeout=120)
+        assert len(done) == 20 and not not_done
+        assert core._submit_stats["wait_vector_polls"] > polls0, (
+            polls0, core._submit_stats)
+
         @ray_tpu.remote(num_cpus=0.1)
         def boom():
             raise ValueError("intentional")

@@ -120,6 +120,12 @@ def test_v2_rules_registered():
         assert required in rules, f"v2 rule {required} missing"
 
 
+def test_v3_context_rules_registered():
+    rules = core.all_rules()
+    for required in ("RCE001", "RCE002", "FRK001", "DON001"):
+        assert required in rules, f"v3 rule {required} missing"
+
+
 def test_full_tree_wall_time_under_budget_with_warm_graph_cache(repo_report):
     """The whole-program layer must not make tier-1 slow: a full-tree run
     with a warm graph cache stays under 30 s. The module-scoped repo_report

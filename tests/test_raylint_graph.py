@@ -601,3 +601,220 @@ def test_annotated_module_lock_and_rlock_are_recognized(tmp_path):
     assert (f"{mod}:_lock", f"{mod}:_lock") in edges
     # annotated RLock recorded as reentrant
     assert f"{mod}:S._re_lock" in view.rlock_ids()
+
+
+# ---------------------------------------------------------------------------
+# context layer (tools/raylint/context.py): execution-context inference
+# ---------------------------------------------------------------------------
+
+from tools.raylint.context import ContextIndex, context_index  # noqa: E402
+
+_P = "ray_tpu/_private/m.py"
+
+
+def _ctx_index_for(tmp_path, src):
+    root = make_tree(tmp_path, {_P: src})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    return ContextIndex(GraphView(g))
+
+
+def test_context_thread_loop_main_propagation(tmp_path):
+    idx = _ctx_index_for(tmp_path, """
+        import threading
+
+        def start():
+            threading.Thread(target=_bg).start()
+
+        def _bg():
+            shared()
+            tick()
+
+        async def tick():
+            shared()
+
+        def api():
+            shared()
+
+        def shared():
+            pass
+
+        def register(loop):
+            loop.call_soon(_cb)
+
+        def _cb():
+            pass
+    """)
+    # spawn target: thread root, and ONLY thread (not a main entry point)
+    assert idx.contexts((_P, "_bg")) == {"thread"}
+    assert (_P, "_bg") in idx.spawn_targets
+    # async def: loop root; thread does NOT cross into async bodies
+    assert idx.contexts((_P, "tick")) == {"loop"}
+    # a sync helper reachable from all three accumulates all three
+    assert idx.contexts((_P, "shared")) == {"thread", "loop", "main"}
+    # un-spawned sync entry points are main
+    assert idx.contexts((_P, "start")) == {"main"}
+    # loop.call_soon callback is a loop root via the spawn edge
+    assert idx.contexts((_P, "_cb")) == {"loop"}
+    assert (_P, "_cb") in idx.spawn_targets
+
+
+def test_context_fork_crosses_spawns_and_async(tmp_path):
+    idx = _ctx_index_for(tmp_path, """
+        import os
+        import threading
+
+        def _child_main():
+            boot()
+
+        def boot():
+            threading.Thread(target=_flush).start()
+            drain()
+
+        def _flush():
+            pass
+
+        async def drain():
+            pass
+
+        def spawner():
+            return os.fork()
+
+        def outer():
+            return spawner()
+    """)
+    # fork is process-scoped: it crosses thread-spawn edges AND enters
+    # async bodies (the coroutine still runs inside the forked image)
+    assert "fork" in idx.contexts((_P, "boot"))
+    assert "fork" in idx.contexts((_P, "_flush"))
+    assert "fork" in idx.contexts((_P, "drain"))
+    # .forking is reverse reachability from os.fork() sites only
+    assert idx.forking == {(_P, "spawner"), (_P, "outer")}
+    # provenance chain walks back to the fork root
+    chain = idx.chain((_P, "_flush"), "fork")
+    assert chain.startswith("_flush")
+    assert "_child_main" in chain
+
+
+def test_context_always_held_meet_and_cycles(tmp_path):
+    idx = _ctx_index_for(tmp_path, """
+        import threading
+
+        _lock = threading.Lock()
+
+        def entry_a():
+            with _lock:
+                helper()
+                helper2()
+
+        def entry_b():
+            with _lock:
+                ring_a()
+
+        def entry_c():
+            helper2()
+
+        def helper():
+            pass
+
+        def helper2():
+            pass
+
+        def ring_a():
+            ring_b()
+
+        def ring_b():
+            ring_a()
+
+        def orbit_a():
+            orbit_b()
+
+        def orbit_b():
+            orbit_a()
+    """)
+    # every caller holds the lock -> the helper inherits it
+    held = idx.always_held((_P, "helper"))
+    assert len(held) == 1 and next(iter(held)).endswith("_lock")
+    # a cycle with ONE locked outside entry converges to that entry's truth
+    assert idx.always_held((_P, "ring_a")) == held
+    assert idx.always_held((_P, "ring_b")) == held
+    # meet over callers: one unlocked caller degrades to the empty set
+    assert idx.always_held((_P, "helper2")) == frozenset()
+    # an isolated mutual-recursion cycle stays at top internally (no known
+    # entry) and degrades to the SAFE answer — no lock credit — at query
+    assert idx._always[(_P, "orbit_a")] is None
+    assert idx.always_held((_P, "orbit_a")) == frozenset()
+
+
+def test_context_memo_per_view(tmp_path):
+    root = make_tree(tmp_path, {_P: "def f():\n    pass\n"})
+    g = ProjectGraph(root, cache_path=None, use_cache=False)
+    view = GraphView(g)
+    idx1 = context_index(view)
+    assert context_index(view) is idx1  # memoized on the view
+    # a different view (e.g. an overlay) gets its own index
+    assert context_index(GraphView(g)) is not idx1
+
+
+def test_context_cache_roundtrip_and_invalidation(tmp_path):
+    src = """
+        import threading
+
+        def start():
+            threading.Thread(target=_bg).start()
+
+        def _bg():
+            helper()
+
+        def helper():
+            pass
+    """
+    root = make_tree(tmp_path, {_P: src})
+    cache = tmp_path / "graphcache.json"
+
+    g1 = ProjectGraph(root, cache_path=cache)
+    idx1 = ContextIndex(GraphView(g1))
+    assert idx1.cache_hit is False
+    assert idx1.contexts((_P, "helper")) >= {"thread"}
+
+    # warm rebuild: the contexts section rides the graph cache
+    g2 = ProjectGraph(root, cache_path=cache)
+    idx2 = ContextIndex(GraphView(g2))
+    assert idx2.cache_hit is True
+    assert idx2.ctx == idx1.ctx
+    assert idx2._always == idx1._always
+    assert idx2.spawn_targets == idx1.spawn_targets
+    assert idx2.forking == idx1.forking
+
+    # contexts-section schema bump -> recompute (same answers)
+    doc = json.loads(cache.read_text())
+    doc["contexts"]["graph_version"] = -1
+    cache.write_text(json.dumps(doc))
+    g3 = ProjectGraph(root, cache_path=cache)
+    idx3 = ContextIndex(GraphView(g3))
+    assert idx3.cache_hit is False
+    assert idx3.ctx == idx1.ctx
+
+    # editing a file changes the fingerprint -> recompute, new facts land
+    (root / _P).write_text((root / _P).read_text()
+                           + "\ndef extra():\n    helper()\n")
+    g4 = ProjectGraph(root, cache_path=cache)
+    idx4 = ContextIndex(GraphView(g4))
+    assert idx4.cache_hit is False
+    assert (_P, "extra") in idx4.ctx
+
+
+def test_context_overlay_view_never_uses_disk_cache(tmp_path):
+    root = make_tree(tmp_path, {_P: "def f():\n    pass\n"})
+    cache = tmp_path / "graphcache.json"
+    g1 = ProjectGraph(root, cache_path=cache)
+    ContextIndex(GraphView(g1))  # seeds the contexts section
+    overlay = summarize_module(_P, "def g():\n    pass\n")
+    idx = ContextIndex(GraphView(ProjectGraph(root, cache_path=cache),
+                                 overlay=overlay))
+    # the overlay's summaries differ from disk: it must recompute, and
+    # must not clobber the pristine cache either
+    assert idx.cache_hit is False
+    assert (_P, "g") in idx.ctx
+    doc = json.loads(cache.read_text())
+    cached_quals = {k.rsplit("||", 1)[-1] for k in doc["contexts"]["ctx"]}
+    assert "f" in cached_quals and "g" not in cached_quals

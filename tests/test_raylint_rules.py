@@ -1641,3 +1641,453 @@ def test_obs001_quant_metrics_negative_pr14_shapes():
                 pass
     """, rules=["OBS001"])
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# RCE001 — shared-state race across disjoint execution contexts
+# ---------------------------------------------------------------------------
+
+
+def test_rce001_positive_thread_vs_loop_writers(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self.pending = 0
+
+            def start(self):
+                threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                self.pending = self.pending + 1
+
+            async def drain(self):
+                self.pending = 0
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE001"])
+    assert rules_of(findings) == ["RCE001"]
+    assert "Pump.pending" in findings[0].message
+    # both sites named with their context sets
+    assert "thread" in findings[0].message
+    assert "loop" in findings[0].message
+
+
+def test_rce001_positive_single_site_lazy_init(tmp_path):
+    # the task_events._enabled shape: ONE unlocked check-then-act write
+    # whose function is reachable from a background thread and the loop —
+    # the site races with itself
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        _cache = None
+
+        def get_cache():
+            global _cache
+            if _cache is None:
+                _cache = {}
+            return _cache
+
+        def start():
+            threading.Thread(target=_bg).start()
+
+        def _bg():
+            get_cache()
+
+        async def tick():
+            get_cache()
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE001"])
+    assert rules_of(findings) == ["RCE001"]
+    assert "_cache" in findings[0].message
+    assert "single site" in findings[0].message
+
+
+def test_rce001_negative_common_lock(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.pending = 0
+
+            def start(self):
+                threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                with self._lock:
+                    self.pending = self.pending + 1
+
+            async def drain(self):
+                with self._lock:
+                    self.pending = 0
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE001"])
+    assert findings == []
+
+
+def test_rce001_negative_overlapping_contexts(tmp_path):
+    # two unlocked write sites, but both run in caller ("main") context:
+    # no provably disjoint pair, so the disjointness gate keeps it silent
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        class Counter:
+            def bump(self):
+                self.n = 1
+
+            def reset(self):
+                self.n = 0
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE001"])
+    assert findings == []
+
+
+def test_rce001_negative_single_site_double_checked_lock(tmp_path):
+    # the sanctioned fix for the lazy-init shape: the write under the lock
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        _init_lock = threading.Lock()
+        _cache = None
+
+        def get_cache():
+            global _cache
+            if _cache is None:
+                with _init_lock:
+                    if _cache is None:
+                        _cache = {}
+            return _cache
+
+        def start():
+            threading.Thread(target=_bg).start()
+
+        def _bg():
+            get_cache()
+
+        async def tick():
+            get_cache()
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE001"])
+    assert findings == []
+
+
+def test_rce001_suppression(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Pump:
+            def start(self):
+                threading.Thread(target=self._worker).start()
+
+            def _worker(self):
+                self.pending = self.pending + 1
+
+            async def drain(self):
+                # raylint: disable=RCE001 benign diagnostic counter, torn values tolerated
+                self.pending = 0
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE001"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# RCE002 — loop-read x thread-write advisory
+# ---------------------------------------------------------------------------
+
+
+def test_rce002_positive_loop_read_thread_write(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Pipe:
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.closed = True
+
+            async def poll(self):
+                return self.closed
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE002"])
+    assert rules_of(findings) == ["RCE002"]
+    assert "Pipe.closed" in findings[0].message
+    # anchored at the thread-side write
+    assert "self.closed = True" in findings[0].snippet
+
+
+def test_rce002_negative_deque_handoff_idiom(tmp_path):
+    # the sanctioned single-bytecode handoff: thread appends, loop pops
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+        from collections import deque
+
+        class Pipe:
+            def __init__(self):
+                self.q = deque()
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.q.append(1)
+
+            async def poll(self):
+                if self.q:
+                    return self.q.popleft()
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE002"])
+    assert findings == []
+
+
+def test_rce002_negative_locked_write(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._init_lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self.closed = True
+
+            async def poll(self):
+                return self.closed
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE002"])
+    assert findings == []
+
+
+def test_rce002_suppression(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import threading
+
+        class Pipe:
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                # raylint: disable=RCE002 monotonic flag, stale read only delays shutdown one tick
+                self.closed = True
+
+            async def poll(self):
+                return self.closed
+    """, relpath="ray_tpu/_private/svc.py", root=tmp_path, rules=["RCE002"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# FRK001 — fork-safety gate
+# ---------------------------------------------------------------------------
+
+
+def test_frk001_positive_zygote_inherited_buffer(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        _events = []
+
+        def _child_main():
+            serve()
+
+        def serve():
+            _events.append(1)
+    """, relpath="ray_tpu/_private/boot.py", root=tmp_path, rules=["FRK001"])
+    assert rules_of(findings) == ["FRK001"]
+    assert "`_events`" in findings[0].message
+    # the provenance chain names how fork-child context reaches the state
+    assert "_child_main" in findings[0].message
+    # anchored at the module-state definition, not the use site
+    assert findings[0].snippet == "_events = []"
+
+
+def test_frk001_negative_fork_reachable_reset_hook(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        _events = []
+
+        def _child_main():
+            reset_after_fork()
+            serve()
+
+        def reset_after_fork():
+            _events.clear()
+
+        def serve():
+            _events.append(1)
+    """, relpath="ray_tpu/_private/boot.py", root=tmp_path, rules=["FRK001"])
+    assert findings == []
+
+
+def test_frk001_positive_lock_held_across_fork(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import os
+        import threading
+
+        _state_lock = threading.Lock()
+
+        def spawn():
+            with _state_lock:
+                pid = os.fork()
+            return pid
+    """, relpath="ray_tpu/_private/boot.py", root=tmp_path, rules=["FRK001"])
+    assert rules_of(findings) == ["FRK001"]
+    assert "os.fork() while holding" in findings[0].message
+
+
+def test_frk001_positive_call_into_fork_path_while_locked(tmp_path):
+    # the lock is released before THIS function's own fork... but the
+    # caller holds one across a call that transitively reaches os.fork()
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import os
+        import threading
+
+        _state_lock = threading.Lock()
+
+        def outer():
+            with _state_lock:
+                return spawn()
+
+        def spawn():
+            return os.fork()
+    """, relpath="ray_tpu/_private/boot.py", root=tmp_path, rules=["FRK001"])
+    assert rules_of(findings) == ["FRK001"]
+    assert "fork path `spawn`" in findings[0].message
+
+
+def test_frk001_suppression(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        # raylint: disable=FRK001 append-only registry, identical in parent and child
+        _events = []
+
+        def _child_main():
+            serve()
+
+        def serve():
+            _events.append(1)
+    """, relpath="ray_tpu/_private/boot.py", root=tmp_path, rules=["FRK001"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# DON001 — use-after-donate in the jit planes
+# ---------------------------------------------------------------------------
+
+
+def test_don001_positive_read_after_donating_call(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import jax
+
+        def train(params, grads, update):
+            step = jax.jit(update, donate_argnums=(0,))
+            new_params = step(params, grads)
+            norm = params
+            return new_params, norm
+    """, relpath="ray_tpu/parallel/mod.py", root=tmp_path, rules=["DON001"])
+    assert rules_of(findings) == ["DON001"]
+    assert "`params` was donated" in findings[0].message
+    assert findings[0].snippet == "norm = params"
+
+
+def test_don001_positive_read_on_one_branch_only(tmp_path):
+    # forward-MAY analysis: a read on any path after the donation fires,
+    # even when the other branch never touches the buffer
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import jax
+
+        def train(params, grads, update, debug, log):
+            step = jax.jit(update, donate_argnums=(0,))
+            out = step(params, grads)
+            if debug:
+                log(params)
+            return out
+    """, relpath="ray_tpu/parallel/mod.py", root=tmp_path, rules=["DON001"])
+    assert rules_of(findings) == ["DON001"]
+    assert "log(params)" in findings[0].snippet
+
+
+def test_don001_negative_rebind_kills_the_fact(tmp_path):
+    # the sanctioned donation idiom: read before, rebind from the result
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import jax
+
+        def train(params, grads, update):
+            step = jax.jit(update, donate_argnums=(0,))
+            norm = params
+            params = step(params, grads)
+            return params, norm
+    """, relpath="ray_tpu/parallel/mod.py", root=tmp_path, rules=["DON001"])
+    assert findings == []
+
+
+def test_don001_decorated_partial_donate_argnames(tmp_path):
+    # @partial(jax.jit, donate_argnames=...) resolves names to positions
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, donate_argnames=("state",))
+        def update(state, batch):
+            return state
+
+        def drive(state, batch):
+            new = update(state, batch)
+            return state
+    """, relpath="ray_tpu/parallel/mod.py", root=tmp_path, rules=["DON001"])
+    assert rules_of(findings) == ["DON001"]
+    assert findings[0].snippet == "return state"
+
+
+def test_don001_conditional_argnums_fold_to_may_donate(tmp_path):
+    # (0,) if donate else None folds to the UNION: may-donate -> finding
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import jax
+
+        def train(params, grads, update, donate):
+            step = jax.jit(update, donate_argnums=(0,) if donate else None)
+            out = step(params, grads)
+            return params
+    """, relpath="ray_tpu/parallel/mod.py", root=tmp_path, rules=["DON001"])
+    assert rules_of(findings) == ["DON001"]
+
+
+def test_don001_out_of_scope_module_is_silent(tmp_path):
+    # same source outside the jit planes: not DON001's business
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import jax
+
+        def train(params, grads, update):
+            step = jax.jit(update, donate_argnums=(0,))
+            new_params = step(params, grads)
+            norm = params
+            return new_params, norm
+    """, relpath="ray_tpu/_private/mod.py", root=tmp_path, rules=["DON001"])
+    assert findings == []
+
+
+def test_don001_suppression(tmp_path):
+    (tmp_path / "ray_tpu").mkdir(parents=True)
+    findings = lint("""
+        import jax
+
+        def train(params, grads, update):
+            step = jax.jit(update, donate_argnums=(0,))
+            new_params = step(params, grads)
+            norm = params  # raylint: disable=DON001 host-side numpy mirror, not a device buffer
+            return new_params, norm
+    """, relpath="ray_tpu/parallel/mod.py", root=tmp_path, rules=["DON001"])
+    assert findings == []

@@ -144,3 +144,52 @@ def test_http_proxy(cluster):
         out = json.loads(resp.read())
     assert out["result"]["echo"] == {"hi": 1}
     serve.delete("echo")
+
+
+def test_replica_peak_sampling_under_stats_lock():
+    """Regression (raylint RCE001): _Replica's ongoing/peak counters are
+    mutated on the replica's event loop but take_ongoing_peak() runs on a
+    sync actor-pool thread, and its read-reset is a two-step RMW. The
+    stats lock keeps a burst that fully drains between two autoscaler
+    polls from being silently dropped. No cluster: the replica is driven
+    directly on a private event loop."""
+    import asyncio
+    import threading
+
+    import cloudpickle
+
+    from ray_tpu.serve.api import _Replica
+
+    class SlowTarget:
+        def __init__(self):
+            self.gate = asyncio.Event()
+
+        async def __call__(self):
+            await self.gate.wait()
+            return "ok"
+
+    loop = asyncio.new_event_loop()
+    runner = threading.Thread(target=loop.run_forever, daemon=True)
+    runner.start()
+    try:
+        replica = _Replica.cls(cloudpickle.dumps(SlowTarget),
+                               cloudpickle.dumps(((), {})))
+        args_blob = cloudpickle.dumps(((), {}))
+        futs = [asyncio.run_coroutine_threadsafe(
+            replica.handle_request("__call__", args_blob), loop)
+            for _ in range(3)]
+        deadline = time.monotonic() + 10
+        while replica.num_ongoing() < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert replica.num_ongoing() == 3
+        # the burst drains COMPLETELY before the autoscaler's next poll...
+        loop.call_soon_threadsafe(replica._callable.gate.set)
+        assert [f.result(10) for f in futs] == ["ok"] * 3
+        assert replica.num_ongoing() == 0
+        # ...yet the poll still sees its high-water mark, exactly once
+        assert replica.take_ongoing_peak() == 3
+        assert replica.take_ongoing_peak() == 0
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        runner.join(5)
+        loop.close()

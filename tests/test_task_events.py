@@ -299,3 +299,47 @@ def test_dashboard_tasks_endpoints(traced_cluster):
     probe_counts = next((v for k, v in summ["per_function"].items()
                          if k.endswith("dash_probe")), {})
     assert probe_counts.get("FINISHED", 0) >= 1, summ
+
+
+def test_set_enabled_override_survives_racing_env_read():
+    """Regression (raylint RCE001, single-site lazy init): a set_enabled()
+    override issued while another thread is mid-way through enabled()'s
+    first env read must not be clobbered by that thread's stale result.
+    Pre-fix, the unlocked check-then-act in enabled() lost exactly this
+    update; the double-checked lock orders the override after the read."""
+    import os as real_os
+    import threading
+
+    from ray_tpu._private import task_events
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    class SlowEnviron:
+        def get(self, key, default=None):
+            if key == "RAY_TPU_TASK_EVENTS":
+                entered.set()
+                release.wait(10)
+            return real_os.environ.get(key, default)
+
+    class FakeOS:
+        environ = SlowEnviron()
+
+    task_events.set_enabled(None)  # force the lazy env re-read
+    task_events.os = FakeOS()  # only task_events' view of os.environ
+    try:
+        reader = threading.Thread(target=task_events.enabled)
+        reader.start()
+        assert entered.wait(10), "reader never reached the env read"
+        overrider = threading.Thread(
+            target=task_events.set_enabled, args=(False,))
+        overrider.start()
+        time.sleep(0.1)  # let the override reach (and block on) _lock
+        release.set()
+        reader.join(10)
+        overrider.join(10)
+        assert not reader.is_alive() and not overrider.is_alive()
+        assert task_events.enabled() is False
+    finally:
+        task_events.os = real_os
+        task_events.set_enabled(None)

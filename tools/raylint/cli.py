@@ -164,6 +164,10 @@ def _run(args, rule_names) -> int:
             print(f"  graph    {g['build_seconds'] * 1000:9.1f} ms  "
                   f"{g['files']} file(s), {g['cache_hits']} cache hit(s), "
                   f"{g['parsed']} parsed", file=sys.stderr)
+            if "context_build_seconds" in g:
+                hit = "cached" if g.get("context_cache_hit") else "computed"
+                print(f"  context  {g['context_build_seconds'] * 1000:9.1f}"
+                      f" ms  ({hit})", file=sys.stderr)
         print(f"  total    {elapsed * 1000:9.1f} ms", file=sys.stderr)
 
     if args.write_baseline:

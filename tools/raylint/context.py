@@ -1,0 +1,348 @@
+"""raylint context layer: execution-context provenance over the call graph.
+
+Every rule so far asks *what* code does; the v3 race/fork/donation rules
+also need to know *who runs it*. This module classifies every function in
+the project graph by the execution contexts that can reach it:
+
+* ``loop`` — event-loop code: ``async def`` bodies plus sync callbacks
+  scheduled via ``call_soon*`` / ``call_later`` / ``create_task`` /
+  ``ensure_future``.
+* ``thread`` — background-thread code: ``threading.Thread(target=...)`` /
+  ``Timer`` targets, ``run_in_executor`` / executor ``.submit`` thunks,
+  and everything they call.
+* ``fork`` — fork-child code: everything reachable from the zygote's
+  ``_child_main`` (crossing spawn edges too — threads started in the child
+  still run inside the forked image).
+* ``main`` — caller-thread code: sync functions nobody spawns that aren't
+  already loop/thread/fork-only, i.e. public API surface executed on
+  whatever thread calls into the library.
+
+Contexts propagate transitively through resolved call edges: ``loop`` and
+``thread`` flow into *sync* callees only (an ``async def`` called from a
+thread is not executed there — it must be scheduled, which is a spawn
+edge); ``fork`` flows through everything because it is process-scoped.
+A function can hold several contexts — a helper called from both the
+reducer thread and the public API is genuinely bi-contextual, and the
+race rules treat overlapping context sets as "cannot prove disjoint".
+
+The index also computes:
+
+* :meth:`ContextIndex.always_held` — the set of lock identities held on
+  EVERY call path into a function (meet-over-callers fixpoint seeded at
+  top), so a write inside ``_drain_locked`` is credited with the caller's
+  ``with self._lock:`` even though the lock is lexically out of frame.
+* :attr:`ContextIndex.forking` — functions that (transitively) reach an
+  ``os.fork()`` call, for FRK001's locks-across-fork gate.
+
+The whole index is cached in ``.graphcache.json`` under a ``contexts``
+section keyed by a fingerprint of every file's content hash, so a warm
+run skips call resolution entirely; overlay views (in-memory fixtures)
+always recompute — their graph differs from the on-disk one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from collections import deque
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.raylint.graph import (FuncKey, GRAPH_SCHEMA_VERSION, GraphView,
+                                 ProjectGraph)
+
+# the context lattice; "main" is assigned in a second phase (see _build)
+CONTEXTS = ("loop", "thread", "fork", "main")
+
+_FIXPOINT_GUARD = 64  # always_held passes; the lattice only descends
+
+
+def _fingerprint(graph: ProjectGraph) -> str:
+    h = hashlib.sha256()
+    for path in sorted(graph.shas):
+        h.update(f"{path}:{graph.shas[path]}\n".encode("utf-8"))
+    return h.hexdigest()
+
+
+def _enc(key: FuncKey) -> str:
+    return f"{key[0]}||{key[1]}"
+
+
+def _dec(text: str) -> FuncKey:
+    path, _, qual = text.partition("||")
+    return (path, qual)
+
+
+class ContextIndex:
+    """Execution-context classification for every function in a GraphView."""
+
+    def __init__(self, view: GraphView):
+        self.view = view
+        self.ctx: Dict[FuncKey, Set[str]] = {}
+        # (func, ctx) -> the caller/spawner that propagated ctx (None = root)
+        self.parent: Dict[Tuple[FuncKey, str], Optional[FuncKey]] = {}
+        self.spawn_targets: Set[FuncKey] = set()
+        self.forking: Set[FuncKey] = set()
+        self._always: Dict[FuncKey, Optional[FrozenSet[str]]] = {}
+        self.build_seconds = 0.0
+        self.cache_hit = False
+        started = time.perf_counter()
+        if not self._load_cached():
+            self._build()
+            self._save_cached()
+        self.build_seconds = time.perf_counter() - started
+        g = getattr(view, "graph", None)
+        if g is not None and view.overlay is None:
+            g.stats["context_build_seconds"] = self.build_seconds
+            g.stats["context_cache_hit"] = self.cache_hit
+
+    # -- public queries ------------------------------------------------------
+
+    def contexts(self, key: FuncKey) -> Set[str]:
+        return self.ctx.get(key, set())
+
+    def always_held(self, key: FuncKey) -> FrozenSet[str]:
+        """Locks held on every known call path into ``key``. Top (a function
+        only reachable through unresolved cycles) degrades to the empty set:
+        claiming protection we cannot prove would hide races."""
+        return self._always.get(key) or frozenset()
+
+    def chain(self, key: FuncKey, ctx: str, limit: int = 6) -> str:
+        """Provenance: ``root.qual -> ... -> key.qual`` for one context."""
+        hops: List[str] = []
+        cur: Optional[FuncKey] = key
+        seen: Set[FuncKey] = set()
+        while cur is not None and cur not in seen and len(hops) < limit:
+            seen.add(cur)
+            hops.append(cur[1])
+            cur = self.parent.get((cur, ctx))
+        return " <- ".join(hops)
+
+    # -- construction --------------------------------------------------------
+
+    def _funcs(self):
+        for path, mod in self.view.modules():
+            for qual, func in mod["functions"].items():
+                yield (path, qual), func
+
+    def _build(self):
+        view = self.view
+        callees: Dict[FuncKey, List[FuncKey]] = {}
+        callers: Dict[FuncKey, List[Tuple[FuncKey, Tuple[str, ...]]]] = {}
+        spawn_edges: Dict[FuncKey, List[Tuple[str, FuncKey]]] = {}
+        loop_roots: List[FuncKey] = []
+        thread_roots: List[FuncKey] = []
+        fork_roots: List[FuncKey] = []
+        fork_sites: List[FuncKey] = []
+
+        for key, func in self._funcs():
+            path = key[0]
+            outs: List[FuncKey] = []
+            for call in func["calls"]:
+                target = view.resolve_call(path, func, call)
+                if target is None or target == key:
+                    continue
+                outs.append(target)
+                callers.setdefault(target, []).append(
+                    (key, tuple(call["held"])))
+            callees[key] = outs
+            for kind, dotted, _line in func.get("spawns", ()):
+                target = view.resolve_call(path, func, {"raw": dotted})
+                if target is None:
+                    continue
+                spawn_edges.setdefault(key, []).append((kind, target))
+                self.spawn_targets.add(target)
+                (thread_roots if kind == "thread" else loop_roots).append(
+                    target)
+            if func["is_async"]:
+                loop_roots.append(key)
+            if key[1].split(".")[-1] == "_child_main":
+                fork_roots.append(key)
+            if func.get("forks"):
+                fork_sites.append(key)
+
+        self._callees = callees
+        self._callers = callers
+        self._spawn_edges = spawn_edges
+
+        self._propagate("loop", loop_roots, cross_spawn=False,
+                        into_async=False)
+        self._propagate("thread", thread_roots, cross_spawn=False,
+                        into_async=False)
+        self._propagate("fork", fork_roots, cross_spawn=True, into_async=True)
+        # phase 2: sync functions not spawned anywhere and not already
+        # claimed by loop/thread/fork run on whichever thread calls the
+        # library — the "main" context
+        main_roots = []
+        for key, func in self._funcs():
+            if func["is_async"] or key in self.spawn_targets:
+                continue
+            have = self.ctx.get(key, set())
+            if have & {"loop", "thread", "fork"}:
+                continue
+            main_roots.append(key)
+        self._propagate("main", main_roots, cross_spawn=False,
+                        into_async=False)
+
+        self._compute_forking(fork_sites)
+        self._compute_always_held()
+
+    def _add_ctx(self, key: FuncKey, ctx: str,
+                 parent: Optional[FuncKey]) -> bool:
+        have = self.ctx.setdefault(key, set())
+        if ctx in have:
+            return False
+        have.add(ctx)
+        self.parent[(key, ctx)] = parent
+        return True
+
+    def _propagate(self, ctx: str, roots: List[FuncKey], cross_spawn: bool,
+                   into_async: bool):
+        q: deque = deque()
+        for root in roots:
+            if self.view.func(root) is not None \
+                    and self._add_ctx(root, ctx, None):
+                q.append(root)
+        while q:
+            key = q.popleft()
+            for callee in self._callees.get(key, ()):
+                tf = self.view.func(callee)
+                if tf is None:
+                    continue
+                if tf["is_async"] and not into_async:
+                    continue  # an async callee runs on the loop, not here
+                if self._add_ctx(callee, ctx, key):
+                    q.append(callee)
+            if cross_spawn:
+                for _kind, target in self._spawn_edges.get(key, ()):
+                    if self._add_ctx(target, ctx, key):
+                        q.append(target)
+
+    def _compute_forking(self, fork_sites: List[FuncKey]):
+        """Functions that transitively reach an ``os.fork()`` call: reverse
+        reachability from the direct fork sites."""
+        q = deque(fork_sites)
+        self.forking.update(fork_sites)
+        while q:
+            key = q.popleft()
+            for caller, _held in self._callers.get(key, ()):
+                if caller not in self.forking:
+                    self.forking.add(caller)
+                    q.append(caller)
+
+    def _compute_always_held(self):
+        """Meet-over-callers fixpoint. Roots (spawn targets, async defs,
+        ``_child_main``, functions with no resolved caller) start and stay
+        at the empty set — they are entered lock-free. Everything else
+        starts at top (None) and descends as caller values resolve, so a
+        cycle with one outside entry converges to that entry's truth."""
+        always = self._always
+        for key, func in self._funcs():
+            if func["is_async"] or key in self.spawn_targets \
+                    or key[1].split(".")[-1] == "_child_main" \
+                    or not self._callers.get(key):
+                always[key] = frozenset()
+            else:
+                always[key] = None  # top
+        roots = {k for k, v in always.items() if v == frozenset()}
+        for _ in range(_FIXPOINT_GUARD):
+            changed = False
+            for key, sites in self._callers.items():
+                if key in roots or key not in always:
+                    continue
+                meet: Optional[FrozenSet[str]] = None
+                for caller, held in sites:
+                    ch = always.get(caller)
+                    if ch is None:
+                        continue  # top caller: no constraint yet
+                    contrib = frozenset(held) | ch
+                    meet = contrib if meet is None else (meet & contrib)
+                if meet is not None and meet != always[key]:
+                    always[key] = meet
+                    changed = True
+            if not changed:
+                break
+
+    # -- disk cache ----------------------------------------------------------
+
+    def _cache_doc_path(self):
+        g = getattr(self.view, "graph", None)
+        if g is None or self.view.overlay is not None:
+            return None
+        if not g.use_cache or g.cache_path is None:
+            return None
+        return g.cache_path
+
+    def _load_cached(self) -> bool:
+        path = self._cache_doc_path()
+        if path is None or not path.is_file():
+            return False
+        try:
+            doc = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            return False
+        section = doc.get("contexts")
+        if not isinstance(section, dict):
+            return False
+        if section.get("graph_version") != GRAPH_SCHEMA_VERSION \
+                or section.get("fingerprint") != _fingerprint(self.view.graph):
+            return False
+        try:
+            self.ctx = {_dec(k): set(v) for k, v in section["ctx"].items()}
+            self.parent = {
+                (_dec(k), c): (_dec(p) if p is not None else None)
+                for k, per in section["parent"].items()
+                for c, p in per.items()}
+            self.spawn_targets = {_dec(k) for k in section["spawn_targets"]}
+            self.forking = {_dec(k) for k in section["forking"]}
+            self._always = {
+                _dec(k): (frozenset(v) if v is not None else None)
+                for k, v in section["always_held"].items()}
+        except (KeyError, TypeError, AttributeError):
+            return False
+        self.cache_hit = True
+        return True
+
+    def _save_cached(self):
+        path = self._cache_doc_path()
+        if path is None:
+            return
+        try:
+            doc = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, ValueError):
+            return  # no graph cache yet: nothing to attach the section to
+        if doc.get("version") != GRAPH_SCHEMA_VERSION:
+            return
+        parent: Dict[str, Dict[str, Optional[str]]] = {}
+        for (key, ctx), par in self.parent.items():
+            parent.setdefault(_enc(key), {})[ctx] = (
+                _enc(par) if par is not None else None)
+        doc["contexts"] = {
+            "graph_version": GRAPH_SCHEMA_VERSION,
+            "fingerprint": _fingerprint(self.view.graph),
+            "ctx": {_enc(k): sorted(v) for k, v in self.ctx.items()},
+            "parent": parent,
+            "spawn_targets": sorted(_enc(k) for k in self.spawn_targets),
+            "forking": sorted(_enc(k) for k in self.forking),
+            "always_held": {
+                _enc(k): (sorted(v) if v is not None else None)
+                for k, v in self._always.items()},
+        }
+        tmp = path.with_suffix(".tmp")
+        try:
+            tmp.write_text(json.dumps(doc, sort_keys=True), encoding="utf-8")
+            os.replace(tmp, path)
+        except OSError:
+            pass  # the cache is an optimization; never fail the lint over it
+
+
+def context_index(view: GraphView) -> ContextIndex:
+    """The (per-view memoized) ContextIndex. Overlay views recompute from
+    their own graph; the shared pristine view builds once per run and uses
+    the ``.graphcache.json`` contexts section across runs."""
+    idx = getattr(view, "_ctx_index", None)
+    if idx is None:
+        idx = ContextIndex(view)
+        view._ctx_index = idx
+    return idx

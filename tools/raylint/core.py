@@ -99,6 +99,7 @@ def all_rules() -> Dict[str, type]:
     if not _RULESETS_LOADED:
         from tools.raylint import rules as _  # noqa: F401  (self-registers)
         from tools.raylint import rules_interp as _i  # noqa: F401
+        from tools.raylint import rules_ctx as _c  # noqa: F401
         _RULESETS_LOADED = True
     return dict(_RULES)
 

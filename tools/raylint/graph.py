@@ -40,7 +40,7 @@ from tools.raylint.rules import _BLOCKING_CALLS, _SOCKET_METHODS, _is_lock_like
 
 # bump whenever summarize_module's output shape or content rules change —
 # cached summaries from an older summarizer are silently wrong otherwise
-GRAPH_SCHEMA_VERSION = 7
+GRAPH_SCHEMA_VERSION = 8
 
 DEFAULT_CACHE_NAME = ".graphcache.json"
 
@@ -51,6 +51,46 @@ _RPC_CALL_TERMINALS = {"call", "notify", "_gcs"}
 # receiver hints for `.result()` — a concurrent.futures result() blocks the
 # calling thread until the future resolves
 _FUTURE_HINTS = ("fut", "future", "promise")
+
+# container methods that are a single bytecode op under the GIL (the
+# sanctioned lock-free producer/consumer idiom on deque) vs. mutations that
+# are compound or invalidate concurrent readers
+_ATOMIC_METHODS = {"append", "appendleft", "pop", "popleft"}
+_MUTATING_METHODS = _ATOMIC_METHODS | {
+    "add", "discard", "remove", "clear", "update", "extend", "insert",
+    "setdefault", "popitem"}
+
+# module-level constructors whose instances are mutable process state; the
+# kind feeds FRK001's fork-safety gate and RCE001's field classification
+_STATE_CONSTRUCTORS = {
+    "Lock": "lock", "RLock": "lock", "Condition": "lock", "Event": "lock",
+    "Semaphore": "lock", "BoundedSemaphore": "lock", "Barrier": "lock",
+    "ContextVar": "contextvar",
+    "deque": "buffer", "defaultdict": "buffer", "Counter": "buffer",
+    "OrderedDict": "buffer", "dict": "buffer", "list": "buffer",
+    "set": "buffer", "Queue": "buffer", "SimpleQueue": "buffer",
+    "LifoQueue": "buffer", "PriorityQueue": "buffer", "local": "buffer",
+}
+
+# spawn-site shapes: callee terminal -> (context kind, target arg position).
+# Thread(target=...) / Timer(_, f) start a background thread; call_soon* /
+# call_later / create_task / ensure_future schedule onto the event loop.
+_THREAD_SPAWN_ARG = {"Timer": 1, "run_in_executor": 1}
+_LOOP_SPAWN_ARG = {"call_soon": 0, "call_soon_threadsafe": 0,
+                   "call_later": 1, "call_at": 1,
+                   "create_task": 0, "ensure_future": 0, "spawn": 0}
+
+
+def _scoped_walk(fn):
+    """Walk a function's AST without descending into nested defs/lambdas
+    (their bodies bind and run in their own scope)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
 
 
 def _sha(source: str) -> str:
@@ -117,11 +157,55 @@ class _FunctionSummarizer(ast.NodeVisitor):
         self.rel_calls: List[List] = []      # [lockid, line] from .release()
         self.awaits: List[int] = []
         self.held: List[str] = []            # lexical with-lock stack
+        # v3 context/race material
+        self.self_reads: List[List] = []     # [attr, line, held]
+        self.self_writes: List[List] = []    # [attr, line, held, kind]
+        self.global_reads: List[List] = []   # [name, line, held]
+        self.global_writes: List[List] = []  # [name, line, held, kind]
+        self.spawns: List[List] = []         # [kind, dotted target, line]
+        self.forks: List[List] = []          # [line, held] for os.fork()
+        self._skip_attrs: Set[int] = set()   # id(node): method receivers etc.
+        self.global_decls: Set[str] = set()
+        self.local_binds: Set[str] = {
+            a.arg for a in (node.args.posonlyargs + node.args.args
+                            + node.args.kwonlyargs)}
+        for va in (node.args.vararg, node.args.kwarg):
+            if va is not None:
+                self.local_binds.add(va.arg)
+        self._collect_scope(node)
         # lock_id (called while computing the aliases) consults self.aliases,
         # so it must exist — empty — before the alias pass runs
         self.aliases: Dict[str, str] = {}
         self.aliases = self._local_lock_aliases(node)
         self.var_literals = self._literal_assigns(node)
+
+    def _collect_scope(self, fn):
+        """Pre-pass: which plain names are bound locally vs declared
+        ``global``, so a bare-name read can be attributed to module state."""
+        for sub in _scoped_walk(fn):
+            if isinstance(sub, ast.Global):
+                self.global_decls.update(sub.names)
+            elif isinstance(sub, ast.Nonlocal):
+                self.local_binds.update(sub.names)
+            else:
+                targets = []
+                if isinstance(sub, ast.Assign):
+                    targets = sub.targets
+                elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [sub.target]
+                elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                    targets = [sub.target]
+                elif isinstance(sub, ast.withitem) and sub.optional_vars:
+                    targets = [sub.optional_vars]
+                elif isinstance(sub, ast.NamedExpr):
+                    targets = [sub.target]
+                elif isinstance(sub, ast.ExceptHandler) and sub.name:
+                    self.local_binds.add(sub.name)
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            self.local_binds.add(n.id)
+        self.local_binds -= self.global_decls
 
     def _local_lock_aliases(self, fn) -> Dict[str, str]:
         """``lk = self._lock`` (assigned exactly once) lets ``with lk:`` and
@@ -213,6 +297,130 @@ class _FunctionSummarizer(ast.NodeVisitor):
     def visit_AsyncWith(self, node):
         self._visit_with(node)
 
+    # -- shared-state accesses (context/race material) ----------------------
+
+    def _is_module_name(self, name: str) -> bool:
+        return (name in self.owner.state_names
+                and (name in self.global_decls
+                     or name not in self.local_binds))
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load) and self._is_module_name(node.id):
+            self.global_reads.append([node.id, node.lineno, list(self.held)])
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.ctx, ast.Load) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and id(node) not in self._skip_attrs:
+            self.self_reads.append([node.attr, node.lineno, list(self.held)])
+        self.generic_visit(node)
+
+    def _record_store(self, target: ast.AST, line: int, kind: str):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_store(elt, line, kind)
+        elif isinstance(target, ast.Starred):
+            self._record_store(target.value, line, kind)
+        elif isinstance(target, ast.Attribute):
+            if isinstance(target.value, ast.Name) and target.value.id == "self":
+                self.self_writes.append(
+                    [target.attr, line, list(self.held), kind])
+        elif isinstance(target, ast.Name):
+            if self._is_module_name(target.id):
+                self.global_writes.append(
+                    [target.id, line, list(self.held), kind])
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self":
+                self.self_writes.append(
+                    [base.attr, line, list(self.held), "mut"])
+                self._skip_attrs.add(id(base))
+            elif isinstance(base, ast.Name) and self._is_module_name(base.id):
+                self.global_writes.append(
+                    [base.id, line, list(self.held), "mut"])
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._record_store(t, node.lineno, "assign")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._record_store(node.target, node.lineno, "rmw")
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._record_store(node.target, node.lineno, "assign")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                self._record_store(t, node.lineno, "mut")
+        self.generic_visit(node)
+
+    def _check_shared_mutation(self, node: ast.Call, attr: Optional[str]):
+        """``self.X.append(...)`` / ``_buffer.append(...)`` are writes to the
+        container, classified atomic (single bytecode) or compound."""
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return
+        recv = f.value
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            self._skip_attrs.add(id(f))  # `self.method()`: not a data read
+            return
+        if attr not in _MUTATING_METHODS:
+            return
+        kind = "atomic" if attr in _ATOMIC_METHODS else "mut"
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self":
+            self.self_writes.append(
+                [recv.attr, node.lineno, list(self.held), kind])
+            self._skip_attrs.add(id(recv))
+        elif isinstance(recv, ast.Name) and self._is_module_name(recv.id):
+            self.global_writes.append(
+                [recv.id, node.lineno, list(self.held), kind])
+
+    def _spawn_target_expr(self, expr: ast.AST) -> Optional[str]:
+        """Dotted name of a callable handed to a spawn site; a coroutine
+        factory call (``create_task(self._run())``) unwraps to its func."""
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+        if isinstance(expr, ast.Lambda):
+            return None
+        return self.resolver.dotted(expr)
+
+    def _check_spawn(self, node: ast.Call, raw: Optional[str],
+                     attr: Optional[str]):
+        term = attr if attr is not None else (
+            raw.rsplit(".", 1)[-1] if raw else "")
+        target = None
+        kind = None
+        if term in ("Thread", "Process"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    kind, target = "thread", self._spawn_target_expr(kw.value)
+        elif term in _THREAD_SPAWN_ARG:
+            pos = _THREAD_SPAWN_ARG[term]
+            if len(node.args) > pos:
+                kind, target = "thread", self._spawn_target_expr(node.args[pos])
+        elif term == "submit" and isinstance(node.func, ast.Attribute):
+            recv = (self.resolver.dotted(node.func.value) or "").lower()
+            if "executor" in recv or "pool" in recv:
+                if node.args:
+                    kind, target = "thread", self._spawn_target_expr(node.args[0])
+        elif term in _LOOP_SPAWN_ARG:
+            pos = _LOOP_SPAWN_ARG[term]
+            if len(node.args) > pos:
+                kind, target = "loop", self._spawn_target_expr(node.args[pos])
+        if kind and target:
+            self.spawns.append([kind, target, node.lineno])
+
     def visit_Call(self, node: ast.Call):
         raw = self.resolver.dotted(node.func)
         attr = node.func.attr if isinstance(node.func, ast.Attribute) else None
@@ -233,6 +441,10 @@ class _FunctionSummarizer(ast.NodeVisitor):
         self.calls.append(entry)
         self._check_blocking(node, raw, attr)
         self._check_lock_call(node, attr)
+        self._check_shared_mutation(node, attr)
+        self._check_spawn(node, raw, attr)
+        if raw == "os.fork":
+            self.forks.append([node.lineno, list(self.held)])
         self.generic_visit(node)
 
     def _check_blocking(self, node, raw, attr):
@@ -286,6 +498,12 @@ class _FunctionSummarizer(ast.NodeVisitor):
             "rel_calls": self.rel_calls,
             "awaits": self.awaits,
             "var_literals": self.var_literals,
+            "self_reads": self.self_reads,
+            "self_writes": self.self_writes,
+            "global_reads": self.global_reads,
+            "global_writes": self.global_writes,
+            "spawns": self.spawns,
+            "forks": self.forks,
         }
 
 
@@ -301,6 +519,9 @@ class _ModuleSummarizer:
         self.rpc_handlers: List[List] = []   # [name, line]
         self.rpc_dispatch: List[List] = []   # [name, line] (method == "X")
         self.wire_registry: List[dict] = []
+        self.module_state: Dict[str, List] = {}  # name -> [line, kind]
+        self._module_consts: Dict[str, int] = {}  # immutable inits, by line
+        self.state_names: Set[str] = set()
         self._collect_module_names(tree)
         for node in tree.body:
             self._top_level(node)
@@ -325,6 +546,27 @@ class _ModuleSummarizer:
                     self.module_locks.add(t.id)
                     if is_rlock:
                         self.rlocks.add(f"{self.modname}:{t.id}")
+                    self._classify_module_state(t.id, value, node.lineno)
+        self.state_names = set(self.module_state) | set(self._module_consts)
+
+    def _classify_module_state(self, name: str, value, line: int):
+        """Module-level mutable state for FRK001/RCE001: lock primitives,
+        mutable containers, contextvars — and (promoted later) plain
+        constants rebound from function bodies via ``global``."""
+        if name.startswith("__") or value is None:
+            return
+        kind = None
+        if isinstance(value, ast.Call):
+            terminal = (self.resolver.dotted(value.func) or "").rsplit(
+                ".", 1)[-1]
+            kind = _STATE_CONSTRUCTORS.get(terminal)
+        elif isinstance(value, (ast.Dict, ast.List, ast.Set)):
+            kind = "buffer"
+        elif isinstance(value, ast.Constant):
+            self._module_consts.setdefault(name, line)
+            return
+        if kind is not None:
+            self.module_state.setdefault(name, [line, kind])
 
     def _top_level(self, node, cls: Optional[str] = None):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -442,6 +684,14 @@ class _ModuleSummarizer:
                 "decode_fields": decode_fields}
 
     def summary(self) -> dict:
+        # promote constant-initialized module names that some function
+        # rebinds via `global` — those are counters/flags, i.e. mutable
+        # process state FRK001/RCE001 must see
+        for func in self.functions.values():
+            for name, _line, _held, _kind in func["global_writes"]:
+                if name in self._module_consts and name not in self.module_state:
+                    self.module_state[name] = [self._module_consts[name],
+                                               "counter"]
         return {
             "path": self.path,
             "modname": self.modname,
@@ -451,6 +701,7 @@ class _ModuleSummarizer:
             "rpc_handlers": self.rpc_handlers,
             "rpc_dispatch": self.rpc_dispatch,
             "wire_registry": self.wire_registry,
+            "module_state": {k: v for k, v in sorted(self.module_state.items())},
         }
 
 

@@ -1,0 +1,627 @@
+"""raylint context-sensitive rule set: races, fork safety, donation.
+
+Built on the context layer (tools/raylint/context.py), which classifies
+every function by the execution contexts that can reach it (event loop,
+background thread, fork child, caller thread) and computes the locks held
+on every path into a function:
+
+* RCE001 — shared-state race: a ``self.X`` field or module global written
+  from two *provably disjoint* execution contexts with no common lock.
+  Disjointness is the false-positive gate: an over-approximated context
+  set that overlaps the other site's ("this helper runs on the loop AND
+  the caller thread") cannot prove a race, so it stays silent. One
+  exception: a SINGLE unlocked write site whose function is reachable
+  from a background thread and another context races with itself — the
+  same code object runs concurrently in both (the classic unlocked
+  lazy-init ``if _x is None: _x = ...``), so multi-context there is the
+  race, not an over-approximation. Lock
+  credit is the lexical ``with``-stack at the write site union the locks
+  held on every call path into the function (``always_held``), so writes
+  inside ``*_locked`` helpers are attributed correctly. ``__init__``
+  writes are construction-time (happens-before publication) and exempt;
+  single-bytecode container ops (``append``/``popleft``) are exempt here
+  and judged by RCE002.
+* RCE002 — advisory: a field read from event-loop context and written
+  from thread context, neither side locked, without the sanctioned
+  GIL-atomic deque idiom. Weaker than RCE001 (reads tear less loudly
+  than writes) but exactly the stale-read shape that breaks bitwise
+  parity contracts nondeterministically.
+* FRK001 — fork-safety gate, two parts. (a) A module whose code runs in
+  fork-child context and whose module-level mutable state (locks,
+  buffers, counters, contextvars) is touched by that code must define a
+  fork-reachable ``*after_fork*`` reset hook — otherwise state inherited
+  from the zygote image (stale buffers, parent pids, half-filled caches)
+  leaks into every worker. (b) Holding a lock across ``os.fork()`` — or
+  calling into a transitively-forking function while holding one — is an
+  error: the child inherits the locked mutex with no owner thread.
+* DON001 — use-after-donate: inside the jit planes, a variable passed at
+  a ``donate_argnums`` position of a jitted call has its device buffer
+  invalidated by XLA; reading it afterwards on any CFG path returns
+  garbage or raises. ``donate_argnums`` values are constant-folded
+  through tuples, conditionals (``(0, 1) if donate else ()``) and local
+  aliases, so the may-donate set is exact for the repo's idioms.
+
+Per-module reporting, same as rules_interp: whole-program facts are
+memoized on the shared graph view; each module emits only findings that
+anchor in it, so suppressions and baselines stay file-local.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from tools.raylint import flow
+from tools.raylint.context import ContextIndex, context_index
+from tools.raylint.core import Finding, Module, Rule, register_rule
+from tools.raylint.graph import GraphView
+from tools.raylint.rules import _TRACING_TRANSFORMS
+from tools.raylint.rules_interp import _interp_state, _lock_display
+
+# paths whose shared state participates in the race rules
+_RCE_SCOPE = ("ray_tpu/_private/", "ray_tpu/collective/", "ray_tpu/ckpt/",
+              "ray_tpu/weights/", "ray_tpu/serve/")
+
+# the jit planes DON001 watches
+_DON_SCOPE = ("ray_tpu/parallel/", "ray_tpu/train/", "ray_tpu/llm/")
+
+# field names that ARE synchronization primitives: assigning a lock is
+# setup, not shared data the lock rules should race-check
+_LOCKISH_SUFFIXES = ("_lock", "_rlock", "_mutex", "_cv", "_cond",
+                     "_condition", "_event", "_sem", "_semaphore")
+
+
+def _is_lockish_name(name: str) -> bool:
+    return name in ("lock", "mutex", "cv") or name.endswith(_LOCKISH_SUFFIXES)
+
+
+def _ctx_state(module: Module
+               ) -> Tuple[Optional[GraphView], Optional[dict],
+                          Optional[ContextIndex]]:
+    view, summary = _interp_state(module)
+    if view is None or summary is None:
+        return None, None, None
+    return view, summary, context_index(view)
+
+
+class _Site:
+    __slots__ = ("qual", "line", "locks", "ctxs", "kind")
+
+    def __init__(self, qual: str, line: int, locks: FrozenSet[str],
+                 ctxs: FrozenSet[str], kind: str):
+        self.qual = qual
+        self.line = line
+        self.locks = locks
+        self.ctxs = ctxs
+        self.kind = kind
+
+    def where(self) -> str:
+        ctxs = "/".join(sorted(self.ctxs)) or "?"
+        locks = (", holding " + ", ".join(
+            sorted(_lock_display(l) for l in self.locks))
+            if self.locks else ", no lock")
+        return f"`{self.qual}`:{self.line} [{ctxs}{locks}]"
+
+
+def _field_sites(view: GraphView, idx: ContextIndex, path: str
+                 ) -> Dict[Tuple[Optional[str], str], Dict[str, List[_Site]]]:
+    """Per shared field of one module: read/write sites with their context
+    sets and effective locks. Key: (class or None-for-module-global, name).
+    ``fork`` is excluded from the context sets — it is process-scoped, not
+    a thread of execution racing within one process."""
+    mod = view.module(path)
+    out: Dict[Tuple[Optional[str], str], Dict[str, List[_Site]]] = {}
+
+    def bucket(cls: Optional[str], name: str) -> Dict[str, List[_Site]]:
+        return out.setdefault((cls, name), {"reads": [], "writes": []})
+
+    for qual, func in mod["functions"].items():
+        key = (path, qual)
+        if qual.split(".")[-1] == "__init__":
+            continue  # construction happens-before publication
+        ctxs = frozenset(idx.contexts(key)) - {"fork"}
+        if not ctxs:
+            continue  # unreachable/unresolved: cannot attribute a context
+        base = idx.always_held(key)
+        cls = func.get("cls")
+        for attr, line, held, kind in func.get("self_writes", ()):
+            if cls is None or _is_lockish_name(attr):
+                continue
+            bucket(cls, attr)["writes"].append(
+                _Site(qual, line, frozenset(held) | base, ctxs, kind))
+        for attr, line, held in func.get("self_reads", ()):
+            if cls is None or _is_lockish_name(attr):
+                continue
+            bucket(cls, attr)["reads"].append(
+                _Site(qual, line, frozenset(held) | base, ctxs, "read"))
+        for name, line, held, kind in func.get("global_writes", ()):
+            if _is_lockish_name(name):
+                continue
+            bucket(None, name)["writes"].append(
+                _Site(qual, line, frozenset(held) | base, ctxs, kind))
+        for name, line, held in func.get("global_reads", ()):
+            if _is_lockish_name(name):
+                continue
+            bucket(None, name)["reads"].append(
+                _Site(qual, line, frozenset(held) | base, ctxs, "read"))
+    return out
+
+
+def _racing_pair(writes: List[_Site]
+                 ) -> Optional[Tuple[_Site, _Site]]:
+    """First (deterministic) pair of write sites with provably disjoint
+    context sets and no common lock, or None. A single unlocked write
+    site races with ITSELF when its function is reachable from a
+    background thread AND another context (the same code object runs
+    concurrently in both) — returned as (site, site). The self-pair
+    demands a thread context because loop and main can be the same OS
+    thread during startup in some planes; two *distinct* sites with
+    disjoint sets keep the wider loop-vs-caller lattice."""
+    sites = sorted((w for w in writes if w.kind != "atomic"),
+                   key=lambda s: (s.line, s.qual))
+    for i, a in enumerate(sites):
+        for b in sites[i + 1:]:
+            if a.ctxs.isdisjoint(b.ctxs) and not (a.locks & b.locks):
+                return a, b
+    for a in sites:
+        if "thread" in a.ctxs and len(a.ctxs) >= 2 and not a.locks:
+            return a, a
+    return None
+
+
+# ---------------------------------------------------------------------------
+# RCE001 / RCE002 — shared-state races across execution contexts
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class SharedStateRace(Rule):
+    name = "RCE001"
+    summary = ("shared field written from two provably disjoint execution "
+               "contexts (loop/thread/caller) with no common lock: a data "
+               "race the tests can't reproduce deterministically")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        view, summary, idx = _ctx_state(module)
+        if view is None or not module.path.startswith(_RCE_SCOPE):
+            return iter(())
+        findings: List[Finding] = []
+        for (cls, name), sites in sorted(_field_sites(
+                view, idx, module.path).items(), key=lambda kv: str(kv[0])):
+            pair = _racing_pair(sites["writes"])
+            if pair is None:
+                continue
+            a, b = pair
+            display = f"{cls}.{name}" if cls else name
+            anchor = max(a, b, key=lambda s: s.line)
+            if a is b:
+                message = (f"`{display}` is written at {a.where()}, a "
+                           f"single site whose function runs concurrently "
+                           f"in multiple execution contexts, with no lock: "
+                           f"two racing calls interleave the read-check-"
+                           f"write — guard the write with a lock")
+            else:
+                message = (f"`{display}` is written from disjoint execution "
+                           f"contexts with no common lock: {a.where()} vs "
+                           f"{b.where()} — guard both writes with one lock, "
+                           f"or confine mutation to a single context")
+            findings.append(Finding(
+                rule=self.name, path=module.path, line=anchor.line, col=0,
+                message=message,
+                snippet=module.line(anchor.line).strip()))
+        return iter(findings)
+
+
+@register_rule
+class LoopThreadStaleRead(Rule):
+    name = "RCE002"
+    summary = ("advisory: field read on the event loop and written from a "
+               "background thread, neither side locked (deque append/popleft "
+               "single-bytecode idiom exempt): stale reads break parity "
+               "contracts nondeterministically")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        view, summary, idx = _ctx_state(module)
+        if view is None or not module.path.startswith(_RCE_SCOPE):
+            return iter(())
+        findings: List[Finding] = []
+        for (cls, name), sites in sorted(_field_sites(
+                view, idx, module.path).items(), key=lambda kv: str(kv[0])):
+            if _racing_pair(sites["writes"]) is not None:
+                continue  # RCE001 already owns this field
+            loop_reads = [r for r in sites["reads"]
+                          if "loop" in r.ctxs and not r.locks]
+            thread_writes = [w for w in sites["writes"]
+                             if "thread" in w.ctxs and not w.locks
+                             and w.kind != "atomic"]
+            hit = None
+            for r in sorted(loop_reads, key=lambda s: (s.line, s.qual)):
+                for w in sorted(thread_writes, key=lambda s: (s.line, s.qual)):
+                    if r.ctxs.isdisjoint(w.ctxs):
+                        hit = (r, w)
+                        break
+                if hit:
+                    break
+            if hit is None:
+                continue
+            r, w = hit
+            display = f"{cls}.{name}" if cls else name
+            findings.append(Finding(
+                rule=self.name, path=module.path, line=w.line, col=0,
+                message=(f"`{display}` is read on the event loop at "
+                         f"{r.where()} but written from thread context here "
+                         f"({w.where()}) with no lock on either side: the "
+                         f"loop can observe a stale or torn value — lock "
+                         f"both sides, or hand off through a deque/queue"),
+                snippet=module.line(w.line).strip()))
+        return iter(findings)
+
+
+# ---------------------------------------------------------------------------
+# FRK001 — fork-safety gate
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class ForkSafetyGate(Rule):
+    name = "FRK001"
+    summary = ("fork-unsafe state: module-level mutable state used from "
+               "fork-child context without a reset-after-fork hook, or a "
+               "lock held across os.fork() — the zygote image leaks parent "
+               "state (or an ownerless locked mutex) into every worker")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        view, summary, idx = _ctx_state(module)
+        if view is None:
+            return iter(())
+        findings: List[Finding] = []
+        findings.extend(self._unreset_state(module, summary, idx))
+        findings.extend(self._locked_forks(module, view, summary, idx))
+        return iter(findings)
+
+    def _unreset_state(self, module: Module, summary: dict,
+                       idx: ContextIndex) -> List[Finding]:
+        state = summary.get("module_state") or {}
+        if not state:
+            return []
+        fork_funcs = {
+            qual: func for qual, func in summary["functions"].items()
+            if "fork" in idx.contexts((module.path, qual))}
+        if not fork_funcs:
+            return []
+        if any("after_fork" in qual.lower() for qual in fork_funcs):
+            return []  # a fork-reachable reset hook covers the module
+        modname = summary["modname"]
+        touched: Dict[str, str] = {}  # state name -> example fork-ctx qual
+        for qual in sorted(fork_funcs):
+            func = fork_funcs[qual]
+            for name, _line, _held in func.get("global_reads", ()):
+                touched.setdefault(name, qual)
+            for name, _line, _held, _kind in func.get("global_writes", ()):
+                touched.setdefault(name, qual)
+            for lock, _line in (func.get("acquires", [])
+                                + func.get("acq_calls", [])):
+                prefix, _, rest = lock.partition(":")
+                if prefix == modname and "." not in rest and ":" not in rest:
+                    touched.setdefault(rest, qual)
+        out = []
+        for name, (line, kind) in sorted(state.items()):
+            if name not in touched:
+                continue
+            chain = idx.chain((module.path, touched[name]), "fork")
+            out.append(Finding(
+                rule=self.name, path=module.path, line=line, col=0,
+                message=(f"module-level {kind} `{name}` is used from "
+                         f"fork-child context ({chain}) but this module has "
+                         f"no fork-reachable reset hook: state inherited "
+                         f"from the zygote image leaks into every worker — "
+                         f"add a reset_after_fork() wired into "
+                         f"worker_main.reset_observability_after_fork, or "
+                         f"suppress with the reason it is fork-safe"),
+                snippet=module.line(line).strip()))
+        return out
+
+    def _locked_forks(self, module: Module, view: GraphView, summary: dict,
+                      idx: ContextIndex) -> List[Finding]:
+        out = []
+        for qual, func in sorted(summary["functions"].items()):
+            for line, held in func.get("forks", ()):
+                if not held:
+                    continue
+                locks = ", ".join(sorted(_lock_display(l) for l in held))
+                out.append(Finding(
+                    rule=self.name, path=module.path, line=line, col=0,
+                    message=(f"os.fork() while holding lock(s) {locks}: the "
+                             f"child inherits a locked mutex with no owner "
+                             f"thread and deadlocks on first acquire — "
+                             f"release before forking"),
+                    snippet=module.line(line).strip()))
+            for call in func["calls"]:
+                if not call["held"]:
+                    continue
+                target = view.resolve_call(module.path, func, call)
+                if target is None or target not in idx.forking:
+                    continue
+                locks = ", ".join(sorted(_lock_display(l)
+                                         for l in call["held"]))
+                out.append(Finding(
+                    rule=self.name, path=module.path, line=call["line"],
+                    col=0,
+                    message=(f"call into fork path `{target[1]}` while "
+                             f"holding lock(s) {locks}: the forked child "
+                             f"inherits the locked mutex — release before "
+                             f"reaching os.fork()"),
+                    snippet=module.line(call["line"]).strip()))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# DON001 — use-after-donate in the jit planes
+# ---------------------------------------------------------------------------
+
+
+def _terminal(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _is_jit_call(call: ast.Call, resolver) -> bool:
+    dotted = resolver.dotted(call.func) or ""
+    return (dotted in _TRACING_TRANSFORMS
+            or _terminal(dotted) in ("jit", "pjit"))
+
+
+def _fold_argnums(expr: ast.AST, env: Dict[str, List[ast.AST]],
+                  depth: int = 0) -> Optional[Set[int]]:
+    """Constant-fold a donate_argnums expression to a may-donate position
+    set. IfExp folds to the union of both branches; a local alias follows
+    its (single-scope) assignments. None = not statically foldable."""
+    if depth > 4:
+        return None
+    if isinstance(expr, ast.Constant):
+        if expr.value is None:
+            return set()
+        if isinstance(expr.value, bool):
+            return None
+        if isinstance(expr.value, int):
+            return {expr.value}
+        return None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for elt in expr.elts:
+            sub = _fold_argnums(elt, env, depth + 1)
+            if sub is None:
+                return None
+            out |= sub
+        return out
+    if isinstance(expr, ast.IfExp):
+        a = _fold_argnums(expr.body, env, depth + 1)
+        b = _fold_argnums(expr.orelse, env, depth + 1)
+        if a is None and b is None:
+            return None
+        return (a or set()) | (b or set())
+    if isinstance(expr, ast.Name):
+        values = env.get(expr.id)
+        if not values:
+            return None
+        out = set()
+        for value in values:
+            sub = _fold_argnums(value, env, depth + 1)
+            if sub is None:
+                return None
+            out |= sub
+        return out
+    return None
+
+
+def _scope_env(body: List[ast.stmt]) -> Dict[str, List[ast.AST]]:
+    """name -> assigned value expressions within one scope (not crossing
+    nested defs), for folding ``donate_args = (0, 1) if donate else ()``."""
+    env: Dict[str, List[ast.AST]] = {}
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            env.setdefault(node.targets[0].id, []).append(node.value)
+        stack.extend(ast.iter_child_nodes(node))
+    return env
+
+
+def _donate_positions_of(call: ast.Call, resolver,
+                         env: Dict[str, List[ast.AST]],
+                         params: Optional[List[str]] = None
+                         ) -> Optional[Set[int]]:
+    """May-donate positions declared by one jit(...) call, or None."""
+    if not _is_jit_call(call, resolver):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return _fold_argnums(kw.value, env)
+        if kw.arg == "donate_argnames" and params is not None:
+            names: Set[str] = set()
+            value = kw.value
+            elts = value.elts if isinstance(value, (ast.Tuple, ast.List)) \
+                else [value]
+            for elt in elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    names.add(elt.value)
+            return {params.index(n) for n in names if n in params}
+    return None
+
+
+class _DonateBindings:
+    """Which callables in a module donate, and at which positions:
+    ``self._step = jax.jit(fn, donate_argnums=...)`` binds ("self", attr);
+    ``g = jax.jit(...)`` binds ("name", g); a def decorated with
+    ``@jax.jit(...)`` / ``@partial(jax.jit, ...)`` binds ("name", def)."""
+
+    def __init__(self, module: Module):
+        self.self_attrs: Dict[str, Set[int]] = {}
+        self.names: Dict[str, Set[int]] = {}
+        resolver = module.resolver
+        for scope in self._scopes(module.tree):
+            env = _scope_env(scope)
+            for node in scope:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1 \
+                            and isinstance(sub.value, ast.Call):
+                        positions = _donate_positions_of(
+                            sub.value, resolver, env)
+                        if not positions:
+                            continue
+                        t = sub.targets[0]
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            self.self_attrs.setdefault(
+                                t.attr, set()).update(positions)
+                        elif isinstance(t, ast.Name):
+                            self.names.setdefault(
+                                t.id, set()).update(positions)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = [a.arg for a in node.args.posonlyargs + node.args.args]
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                target = dec
+                dotted = resolver.dotted(dec.func) or ""
+                if _terminal(dotted) == "partial" and dec.args:
+                    inner = ast.Call(func=dec.args[0], args=[],
+                                     keywords=dec.keywords)
+                    ast.copy_location(inner, dec)
+                    target = inner
+                positions = _donate_positions_of(target, resolver, {},
+                                                 params=params)
+                if positions:
+                    self.names.setdefault(node.name, set()).update(positions)
+
+    @staticmethod
+    def _scopes(tree: ast.AST):
+        yield tree.body
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node.body
+
+    def __bool__(self):
+        return bool(self.self_attrs or self.names)
+
+    def positions_for(self, call: ast.Call) -> Optional[Set[int]]:
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            return self.self_attrs.get(f.attr)
+        if isinstance(f, ast.Name):
+            return self.names.get(f.id)
+        return None
+
+
+@register_rule
+class UseAfterDonate(Rule):
+    name = "DON001"
+    summary = ("variable read after being passed at a donate_argnums "
+               "position of a jitted call: XLA invalidated its buffer — "
+               "the read returns garbage or raises on any path that "
+               "reaches it")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not module.path.startswith(_DON_SCOPE):
+            return iter(())
+        bindings = _DonateBindings(module)
+        if not bindings:
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_fn(module, bindings, node))
+        return iter(findings)
+
+    def _check_fn(self, module: Module, bindings: _DonateBindings,
+                  fn: ast.AST) -> List[Finding]:
+        cfg = flow.build_cfg(fn)
+        if not cfg.nodes:
+            return []
+        gens: Dict[int, List[Tuple[str, int]]] = {}
+        kills: Dict[int, Set[str]] = {}
+        for i, stmt in enumerate(cfg.nodes):
+            g: List[Tuple[str, int]] = []
+            for call in flow.stmt_calls(stmt):
+                positions = bindings.positions_for(call)
+                if not positions:
+                    continue
+                for pos in sorted(positions):
+                    if pos < len(call.args) \
+                            and isinstance(call.args[pos], ast.Name):
+                        g.append((call.args[pos].id, call.lineno))
+            gens[i] = g
+            kills[i] = self._killed(stmt)
+        if not any(gens.values()):
+            return []
+        index_of = {id(s): i for i, s in enumerate(cfg.nodes)}
+
+        def transfer(stmt: ast.stmt, facts: FrozenSet) -> FrozenSet:
+            i = index_of[id(stmt)]
+            out = set(facts)
+            out.update(gens[i])
+            return frozenset(f for f in out if f[0] not in kills[i])
+
+        IN = flow.forward_may(cfg, transfer)
+        findings: List[Finding] = []
+        seen: Set[Tuple[int, str]] = set()
+        for i, stmt in enumerate(cfg.nodes):
+            facts = IN[i]
+            if not facts:
+                continue
+            donated = {}
+            for name, line in facts:
+                donated.setdefault(name, line)
+            for node in flow._header_walk(stmt):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in donated \
+                        and (node.lineno, node.id) not in seen:
+                    seen.add((node.lineno, node.id))
+                    findings.append(Finding(
+                        rule=self.name, path=module.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=(f"`{node.id}` was donated to the jitted "
+                                 f"call at line {donated[node.id]} "
+                                 f"(donate_argnums): its device buffer is "
+                                 f"invalidated — reading it afterwards "
+                                 f"returns garbage or raises; reorder the "
+                                 f"read before the call, rebind the name "
+                                 f"from the call's result, or drop the "
+                                 f"donation"),
+                        snippet=module.line(node.lineno).strip()))
+        return findings
+
+    @staticmethod
+    def _killed(stmt: ast.stmt) -> Set[str]:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.Delete):
+            targets = stmt.targets
+        out: Set[str] = set()
+        for sub in flow._header_walk(stmt):
+            if isinstance(sub, ast.NamedExpr):
+                targets.append(sub.target)
+            elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                for item in sub.items:
+                    if item.optional_vars is not None:
+                        targets.append(item.optional_vars)
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        return out
