@@ -821,7 +821,8 @@ class CoreWorker:
             "kickoff_s": 0.0, "push_s": 0.0, "push_tasks": 0,
             "push_batches": 0, "spec_frames": 0, "kickoff_wakeups": 0,
             "fast_path": 0, "pack_pool_hits": 0, "pack_pool_misses": 0,
-            "wait_vector_polls": 0}
+            "wait_vector_polls": 0, "result_future_batches": 0,
+            "result_futures_batched": 0}
         self._put_index = 0
         self._spread_hint = 0
         self.segments = SegmentCache()
@@ -915,6 +916,25 @@ class CoreWorker:
         if fut is None and oid in self._pending_returns:
             fut = self._result_futures[oid] = self.loop.create_future()
         return fut
+
+    def _ensure_result_futures(self, oids: set) -> int:
+        """Batched ``_ensure_result_future`` (loop thread only): ONE
+        C-level set intersection against the pending-return index finds
+        every ref whose future is demanded but unallocated, then one pass
+        allocates them — the first wait()/get() poll over a k-ref window
+        stops paying k separate dict-probe chains. Returns the number of
+        futures created."""
+        want = self._pending_returns.keys() & oids
+        created = 0
+        for oid in want:
+            if oid not in self._result_futures:
+                self._result_futures[oid] = self.loop.create_future()
+                created += 1
+        if created:
+            # raylint: disable=RCE001 plain diagnostic counters, deliberately unlocked (see _submit_stats init): each += is one dict-slot RMW under the GIL and a lost increment only skews a stat
+            self._submit_stats["result_future_batches"] += 1
+            self._submit_stats["result_futures_batched"] += created
+        return created
 
     def _start_loop(self):
         if self._loop_thread is not None or not self._owned_loop:
@@ -1417,6 +1437,9 @@ class CoreWorker:
         deadline = time.monotonic() + (timeout if timeout is not None else 86400.0)
 
         async def _get_all():
+            # batched lazy-future setup up front: one pass instead of one
+            # _ensure_result_future probe chain per ref inside _get_one
+            self._ensure_result_futures({r.id for r in refs})
             out = []
             for ref in refs:
                 value = await self._get_one(ref, deadline)
@@ -1446,15 +1469,18 @@ class CoreWorker:
                 # key membership IS store-residency.
                 ready_now = self.memory_store.keys() & oid_set
                 ready_now |= self._in_store.keys() & oid_set
-                # raylint: disable=RCE001 plain diagnostic counters, deliberately unlocked (see _submit_stats init): each += is one dict-slot RMW under the GIL and a lost increment only skews a stat
                 self._submit_stats["wait_vector_polls"] += 1
+                # batched lazy-future setup: allocate every still-pending
+                # ref's result future in one pass (first poll does all the
+                # work; later polls find the intersection empty)
+                self._ensure_result_futures(oid_set - ready_now)
                 ready, fut_pending, store_pending = [], [], []
                 for r in refs:
                     oid = r.id
                     if oid in ready_now:
                         ready.append(r)
                         continue
-                    fut = self._ensure_result_future(oid)
+                    fut = self._result_futures.get(oid)
                     if fut is None:
                         store_pending.append(r)
                     elif fut.done():

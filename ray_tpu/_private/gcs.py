@@ -2227,6 +2227,44 @@ class GcsServer:
                     "warm_target": pool.get("warm_target", 0),
                     "misses": pool.get("misses", 0)})
 
+        # -- serve SLOs -------------------------------------------------
+        # the serve controller mirrors per-deployment autoscale state into
+        # the ``serve`` KV namespace; deployments that registered SLO
+        # targets get violation findings when the windowed rates breach
+        for (ns, key), blob in list(self.kv.items()):
+            if ns != "serve" or not blob:
+                continue
+            try:
+                entry = wire.loads(blob)
+            except Exception as e:
+                logger.debug("undecodable serve entry %s: %s", key, e)
+                continue
+            slo = entry.get("slo") or {}
+            rollup = entry.get("rollup") or {}
+            dep = key.decode() if isinstance(key, bytes) else str(key)
+            if entry.get("ts") and now - entry["ts"] > 60.0:
+                continue  # stale mirror (controller gone): not a violation
+            queue_target = slo.get("queue_target_s")
+            queue_p99 = rollup.get("queue_p99_s")
+            if (queue_target is not None and queue_p99 is not None
+                    and queue_p99 > queue_target):
+                findings.append({
+                    "kind": "serve_slo_violation", "severity": "warning",
+                    "deployment": dep, "metric": "queue_p99_s",
+                    "value": queue_p99, "target": queue_target,
+                    "replicas": entry.get("replicas"),
+                    "replica_target": entry.get("target")})
+            latency_budget = slo.get("latency_budget_s")
+            exec_mean = rollup.get("execute_mean_s")
+            if (latency_budget is not None and exec_mean is not None
+                    and exec_mean > latency_budget):
+                findings.append({
+                    "kind": "serve_slo_violation", "severity": "warning",
+                    "deployment": dep, "metric": "execute_mean_s",
+                    "value": exec_mean, "target": latency_budget,
+                    "replicas": entry.get("replicas"),
+                    "replica_target": entry.get("target")})
+
         status = "ok"
         if any(f["severity"] == "error" for f in findings):
             status = "error"
@@ -2241,7 +2279,8 @@ class GcsServer:
         # rate-limited warning logs + structured events (one per finding
         # identity per health_warn_interval_s, not one per scan)
         for f in findings:
-            ident = (f["kind"], f.get("node", ""), f.get("task_id", ""))
+            ident = (f["kind"], f.get("node", ""), f.get("task_id", ""),
+                     f.get("deployment", ""), f.get("metric", ""))
             if now - self._health_warn_ts.get(ident, 0.0) \
                     < cfg.health_warn_interval_s:
                 continue

@@ -100,6 +100,7 @@ class DashboardHead:
             web.get("/api/data_stats", self._data_stats),
             web.get("/api/weights", self._weights),
             web.get("/api/checkpoints", self._checkpoints),
+            web.get("/api/serve", self._serve),
             web.post("/api/profile/stacks", self._profile_stacks),
             web.post("/api/profile/memory", self._profile_memory),
             web.get("/api/jobs", self._jobs_list),
@@ -224,6 +225,16 @@ class DashboardHead:
         from aiohttp import web
 
         return web.json_response(await self._kv_namespace_dump("ckpt"))
+
+    async def _serve(self, request):
+        """Serve autoscale plane: per-deployment replica target vs live
+        count, windowed rate rollup (arrival rate, queue p99, execute
+        mean), registered SLOs and recent scale transitions (mirrored to
+        the ``serve`` KV namespace by the controller every autoscale
+        tick)."""
+        from aiohttp import web
+
+        return web.json_response(await self._kv_namespace_dump("serve"))
 
     async def _node_stats(self, request):
         """Per-node agent sample: node cpu/mem/load + every worker's

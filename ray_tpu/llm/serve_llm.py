@@ -187,15 +187,16 @@ def build_openai_app(configs: List[LLMConfig], params: Any = None
 
 class LLMHandle:
     """Prefix-aware handle: same prompt prefix -> same replica when healthy,
-    keeping likely-shared KV prefixes on one engine (reference:
-    routing_policies/prefix_aware/prefix_aware_router.py)."""
+    keeping likely-shared KV prefixes on one engine. Thin veneer over the
+    first-class ``routing_policy="prefix"`` handle policy
+    (ray_tpu/serve/autoscale/router.py — consistent-hash ring, so replica
+    churn remaps only ~1/N of the prefix space; hit/miss counters land on
+    ``ray_tpu.serve.prefix_cache_*``)."""
 
     def __init__(self, deployment_name: str, prefix_len: int = 64):
-        self._inner = serve_api.DeploymentHandle(deployment_name)
-        self._prefix_len = prefix_len
+        self._inner = serve_api.DeploymentHandle(
+            deployment_name, routing_policy="prefix")
+        self._inner._router().prefix_len = prefix_len
 
     def remote(self, body: dict):
-        prompt = body.get("prompt") or str(body.get("messages", ""))
-        if prompt:
-            return self._inner.remote_with_key(prompt[: self._prefix_len], body)
         return self._inner.remote(body)

@@ -230,6 +230,39 @@ def cmd_workers(args):
               f"restarts={p.get('zygote_restarts', 0)}")
 
 
+def cmd_serve(args):
+    """ray-tpu serve: serve autoscale-plane state per deployment
+    (reference surface: the dashboard's /api/serve; backed by the KV
+    mirror the serve controller publishes every autoscale tick)."""
+    _connect(args)
+    import time as _t
+
+    from ray_tpu.util import state
+
+    deployments = state.serve_state()
+    if args.json:
+        print(json.dumps(deployments, indent=2, default=str))
+        return
+    if not deployments:
+        print("no serve deployments")
+        return
+    for name, entry in sorted(deployments.items()):
+        rollup = entry.get("rollup") or {}
+        qp99 = rollup.get("queue_p99_s")
+        slo = entry.get("slo") or {}
+        print(f"{name}: replicas={entry.get('replicas', 0)}/"
+              f"{entry.get('target', 0)} "
+              f"draining={entry.get('draining', 0)} "
+              f"arrival={rollup.get('arrival_rate', 0.0):.2f}/s "
+              f"queue_p99={'n/a' if qp99 is None else '%.3fs' % qp99}"
+              + (f" slo(queue)={slo.get('queue_target_s')}s"
+                 if slo.get("queue_target_s") is not None else ""))
+        for tr in entry.get("transitions", [])[-args.transitions:]:
+            ts = _t.strftime("%H:%M:%S", _t.localtime(tr.get("ts", 0)))
+            print(f"  {ts} scale {tr.get('direction', '?'):4} "
+                  f"{tr.get('from')}->{tr.get('to')}: {tr.get('reason')}")
+
+
 def cmd_ckpt(args):
     """ray-tpu ckpt: inspect checkpoint-plane stores (ray_tpu/ckpt/).
 
@@ -378,6 +411,13 @@ def main(argv=None):
                                        "provisioning-plane stats")
     p.add_argument("--json", action="store_true", help="raw JSON output")
     p.set_defaults(fn=cmd_workers)
+
+    p = sub.add_parser("serve", help="serve autoscale-plane state "
+                                     "(replicas, rates, scale history)")
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.add_argument("--transitions", type=int, default=4,
+                   help="scale transitions to show per deployment")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("ckpt", help="checkpoint-plane stores "
                                     "(list/inspect/diff)")

@@ -6,8 +6,21 @@ requests enter through a ``DeploymentHandle`` whose router picks a replica by
 power-of-two-choices on queue length (request_router/pow_2_router.py:27);
 an HTTP proxy actor (aiohttp) fronts handles; ``@serve.batch`` provides
 dynamic batching inside replicas (serve/batching.py).
+
+The ``serve/autoscale`` subpackage closes the serving loop: demand-driven
+autoscaling over windowed rates, SLO-aware ingress admission with
+multi-tenant fair queueing, and prefix-cache-aware routing (see
+ray_tpu/serve/README.md).
 """
 
+from ray_tpu.serve.autoscale import (
+    FairQueue,
+    IngressHandle,
+    LoadShedError,
+    PrefixRouter,
+    SLOConfig,
+    build_ingress,
+)
 from ray_tpu.serve.api import (
     Application,
     AutoscalingConfig,
@@ -39,4 +52,10 @@ __all__ = [
     "get_app_handle",
     "batch",
     "start_http_proxy",
+    "FairQueue",
+    "IngressHandle",
+    "LoadShedError",
+    "PrefixRouter",
+    "SLOConfig",
+    "build_ingress",
 ]

@@ -63,6 +63,35 @@ def _obs() -> dict:
         return _obs_metrics
 
 
+_auto_obs_metrics: Optional[dict] = None
+
+
+def _auto_obs() -> dict:
+    """Autoscaler gauges on the shared registry (controller process):
+    flushed into the GCS metrics-history ring like every other metric, so
+    dashboards read scale state as rates over time."""
+    global _auto_obs_metrics
+    with _obs_lock:
+        if _auto_obs_metrics is None:
+            from ray_tpu.util.metrics import Gauge
+
+            _auto_obs_metrics = {
+                "arrival": Gauge(
+                    "ray_tpu.serve.arrival_rate",
+                    "windowed request arrival rate per deployment (req/s)"),
+                "replicas": Gauge(
+                    "ray_tpu.serve.replicas",
+                    "live replica count per deployment"),
+                "target": Gauge(
+                    "ray_tpu.serve.target_replicas",
+                    "autoscaler replica target per deployment"),
+                "queue_p99": Gauge(
+                    "ray_tpu.serve.queue_wait_p99_seconds",
+                    "windowed p99 queue wait per deployment"),
+            }
+        return _auto_obs_metrics
+
+
 # ---------------------------------------------------------------------------
 # public authoring API
 # ---------------------------------------------------------------------------
@@ -70,13 +99,26 @@ def _obs() -> dict:
 
 @dataclass
 class AutoscalingConfig:
-    """Reference: serve/autoscaling_policy.py + config.AutoscalingConfig."""
+    """Reference: serve/autoscaling_policy.py + config.AutoscalingConfig.
+
+    Scaling is demand-driven (``serve/autoscale/``): the controller prices
+    replica demand from windowed RATES (arrival rate x mean execute time,
+    windowed ongoing rollup, queue-wait p99) — never from an
+    instantaneous gauge — then applies the sustained-condition delays,
+    the hysteresis band, and the post-action cooldown below."""
 
     min_replicas: int = 1
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 2.0
     downscale_delay_s: float = 10.0
+    # sliding window the rates are computed over
+    window_s: float = 10.0
+    # a replica is released only when demand clears this band below the
+    # next-lower capacity step (anti-flap)
+    hysteresis: float = 0.1
+    # minimum seconds between any two scale actions
+    scale_cooldown_s: float = 1.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "AutoscalingConfig":
@@ -93,6 +135,10 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = field(default_factory=lambda: {"num_cpus": 1.0})
     health_check_period_s: float = 2.0
     autoscaling: Optional[AutoscalingConfig] = None
+    # per-route SLO targets (ingress.SLOConfig dict): registered with the
+    # controller -> published to the GCS health monitor; the autoscaler
+    # defends queue_target_s as up-pressure
+    slo: Optional[dict] = None
 
 
 class Deployment:
@@ -104,7 +150,8 @@ class Deployment:
     def options(self, *, name: Optional[str] = None, num_replicas=None,
                 max_ongoing_requests: Optional[int] = None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
-                autoscaling_config: Optional[dict] = None) -> "Deployment":
+                autoscaling_config: Optional[dict] = None,
+                slo: Optional[dict] = None) -> "Deployment":
         cfg = copy.deepcopy(self.config)
         if num_replicas == "auto" or autoscaling_config is not None:
             if isinstance(num_replicas, int) and num_replicas != 1:
@@ -120,6 +167,10 @@ class Deployment:
             cfg.max_ongoing_requests = max_ongoing_requests
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
+        if slo is not None:
+            from ray_tpu.serve.autoscale.ingress import SLOConfig
+
+            cfg.slo = SLOConfig.from_dict(slo).to_dict()  # validate keys
         return Deployment(self._target, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> "Application":
@@ -136,9 +187,12 @@ class Application:
 def deployment(target=None, *, name: Optional[str] = None, num_replicas=1,
                max_ongoing_requests: int = 16,
                ray_actor_options: Optional[Dict[str, Any]] = None,
-               autoscaling_config: Optional[dict] = None):
+               autoscaling_config: Optional[dict] = None,
+               slo: Optional[dict] = None):
     """@serve.deployment on a class or function. ``num_replicas="auto"`` or
-    an ``autoscaling_config`` dict enables request-driven autoscaling."""
+    an ``autoscaling_config`` dict enables demand-driven autoscaling; an
+    ``slo`` dict (SLOConfig keys) registers per-route targets with the
+    controller and the cluster health monitor."""
 
     def wrap(t):
         auto = None
@@ -150,11 +204,17 @@ def deployment(target=None, *, name: Optional[str] = None, num_replicas=1,
                     "exclusive; set min/max_replicas in the config instead")
             auto = AutoscalingConfig.from_dict(autoscaling_config or {})
             n = auto.min_replicas
+        slo_dict = None
+        if slo is not None:
+            from ray_tpu.serve.autoscale.ingress import SLOConfig
+
+            slo_dict = SLOConfig.from_dict(slo).to_dict()
         cfg = DeploymentConfig(
             num_replicas=n,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=ray_actor_options or {"num_cpus": 1.0},
             autoscaling=auto,
+            slo=slo_dict,
         )
         return Deployment(t, name or t.__name__, cfg)
 
@@ -195,6 +255,19 @@ class _Replica:
         # its read-reset is a two-step RMW, so without a lock a burst
         # peaking between the read and the reset is silently dropped
         self._stats_lock = _th.Lock()
+        # cumulative demand counters for the rate-based autoscaler
+        # (serve/autoscale/window.py): monotone totals survive any number
+        # of missed polls, so a burst that fully drains between two
+        # control ticks still registers as arrivals
+        self._arrived = 0
+        self._completed = 0
+        self._execute_sum = 0.0
+        self._execute_count = 0
+        import collections as _coll
+
+        # recent per-request queue-wait observations, drained by
+        # take_stats() into the controller's window for the p99 view
+        self._queue_drain = _coll.deque(maxlen=256)
 
     async def handle_request(self, method_name: str, args_blob: bytes):
         import contextvars as _cv
@@ -207,16 +280,21 @@ class _Replica:
         model_id = kwargs.pop("_serve_multiplexed_model_id", "")
         submit_ts = kwargs.pop("_serve_submit_ts", None)
         now = time.time()
+        queue_wait = None
         if submit_ts is not None and now >= submit_ts:
             # handle-dispatch → execution-start wait (the actor queue):
             # built-in queue phase of every serve request
-            _obs()["queue"].observe(now - submit_ts)
+            queue_wait = now - submit_ts
+            _obs()["queue"].observe(queue_wait)
             tracing.record_span("serve.queue", submit_ts, now,
                                 category="serve")
         token = _set_current_model_id(model_id)
         with self._stats_lock:
             self._num_ongoing += 1
             self._peak_ongoing = max(self._peak_ongoing, self._num_ongoing)
+            self._arrived += 1
+            if queue_wait is not None:
+                self._queue_drain.append(queue_wait)
         t_exec = time.perf_counter()
         try:
             if method_name == "__call__":
@@ -241,10 +319,14 @@ class _Replica:
             return out
         finally:
             obs = _obs()
-            obs["execute"].observe(time.perf_counter() - t_exec)
+            dt_exec = time.perf_counter() - t_exec
+            obs["execute"].observe(dt_exec)
             obs["requests"].inc()
             with self._stats_lock:
                 self._num_ongoing -= 1
+                self._completed += 1
+                self._execute_sum += dt_exec
+                self._execute_count += 1
 
     async def handle_request_streaming(self, method_name: str,
                                        args_blob: bytes):
@@ -261,19 +343,25 @@ class _Replica:
         kwargs.pop("_serve_multiplexed_model_id", "")
         submit_ts = kwargs.pop("_serve_submit_ts", None)
         now = time.time()
+        queue_wait = None
         if submit_ts is not None and now >= submit_ts:
             from ray_tpu.util import tracing
 
-            _obs()["queue"].observe(now - submit_ts)
+            queue_wait = now - submit_ts
+            _obs()["queue"].observe(queue_wait)
             tracing.record_span("serve.queue", submit_ts, now,
                                 category="serve")
         if method_name == "__call__":
             fn = self._callable
         else:
             fn = getattr(self._callable, method_name)
+        t_exec = time.perf_counter()
         with self._stats_lock:
             self._num_ongoing += 1
             self._peak_ongoing = max(self._peak_ongoing, self._num_ongoing)
+            self._arrived += 1
+            if queue_wait is not None:
+                self._queue_drain.append(queue_wait)
         try:
             if inspect.isasyncgenfunction(fn):
                 async for chunk in fn(*args, **kwargs):
@@ -293,8 +381,12 @@ class _Replica:
             else:
                 yield out
         finally:
+            dt_exec = time.perf_counter() - t_exec
             with self._stats_lock:
                 self._num_ongoing -= 1
+                self._completed += 1
+                self._execute_sum += dt_exec
+                self._execute_count += 1
 
     def num_ongoing(self) -> int:
         return self._num_ongoing
@@ -307,6 +399,27 @@ class _Replica:
             peak = max(self._peak_ongoing, self._num_ongoing)
             self._peak_ongoing = self._num_ongoing
         return peak
+
+    def take_stats(self) -> dict:
+        """Autoscaler sample v2: cumulative counters + drained queue-wait
+        samples. Counters are CUMULATIVE so the controller's sliding
+        window prices rates from deltas — a burst that arrives and fully
+        drains between two polls still moves ``arrived``/``completed``
+        (the burst-blindness case a point gauge misses)."""
+        with self._stats_lock:
+            peak = max(self._peak_ongoing, self._num_ongoing)
+            self._peak_ongoing = self._num_ongoing
+            queue_samples = list(self._queue_drain)
+            self._queue_drain.clear()
+            return {
+                "arrived": self._arrived,
+                "completed": self._completed,
+                "execute_sum": self._execute_sum,
+                "execute_count": self._execute_count,
+                "ongoing": self._num_ongoing,
+                "peak": peak,
+                "queue_samples": queue_samples,
+            }
 
     def drain(self) -> int:
         """Rolling update support: called on a replica that has been
@@ -372,15 +485,42 @@ class _ServeController:
                             "cfg": cfg, "target": cfg.num_replicas})
                 old["code_version"] += 1
                 old["version"] += 1
+                if cfg.slo:
+                    old["slo"] = dict(cfg.slo)
                 self._reconcile(name)
                 return True
+            from ray_tpu.serve.autoscale import (DeploymentMetricsWindow,
+                                                 PolicyState)
+
+            auto = cfg.autoscaling
             self.apps[name] = {"blob": target_blob, "init": init_blob,
                                "cfg": cfg, "replicas": [], "version": 0,
                                "code_version": 0, "replica_versions": {},
                                "rollout": None,
                                "target": cfg.num_replicas,
-                               "scale_up_since": None, "scale_down_since": None}
+                               "scale_up_since": None, "scale_down_since": None,
+                               # demand-driven autoscale plane: sliding
+                               # rate window fed by replica counter deltas,
+                               # policy smoothing state, bounded scale-event
+                               # history, per-deployment SLO targets
+                               "window": DeploymentMetricsWindow(
+                                   window_s=auto.window_s if auto else 10.0),
+                               "policy_state": PolicyState(),
+                               "transitions": [],
+                               "slo": dict(cfg.slo) if cfg.slo else None,
+                               "draining": []}
             self._reconcile(name)
+        return True
+
+    def register_slo(self, name: str, slo: dict) -> bool:
+        """Ingress handles register per-route SLO targets here; the
+        autoscaler turns the queue-wait target into up-pressure and the
+        GCS health scan reads the published state for violations."""
+        with self._mutate:
+            app = self.apps.get(name)
+            if app is None:
+                return False
+            app["slo"] = dict(slo)
         return True
 
     def _reconcile(self, name: str):
@@ -441,23 +581,60 @@ class _ServeController:
         while len(alive) < want:
             alive.append(_start_replica())
             changed = True
+        draining = app.setdefault("draining", [])
         for extra in alive[want:]:
+            # drain-aware scale-down: the surplus replica leaves the
+            # topology NOW but stays alive until idle — handle caches
+            # refresh on a ~5s TTL, so an immediate kill would drop
+            # requests routed by a stale cache (the autoscale bench's
+            # zero-drop criterion)
             changed = True
             rv.pop(extra, None)
-            try:
-                ray_tpu.kill(extra)
-            except Exception:
-                pass
+            draining.append({
+                "replica": extra, "removed_at": _t.monotonic(),
+                "deadline": _t.monotonic()
+                + getattr(cfg, "graceful_shutdown_timeout_s", 30.0)})
         app["replicas"] = alive[:want]
         keep = {id(app.get("surge_replica")),
                 id((app.get("rollout") or {}).get("draining"))}
         for r in list(rv):
             if r not in app["replicas"] and id(r) not in keep:
                 rv.pop(r, None)
+        if self._advance_scaledown(app):
+            changed = True
         if self._advance_rollout(name, app):
             changed = True
         if changed:
             self._bump(name)
+
+    def _advance_scaledown(self, app: dict) -> bool:
+        """Kill drained scale-down victims: after a stale-cache grace each
+        victim is polled for outstanding requests and killed only at zero
+        (hard-capped by the graceful window)."""
+        import time as _t
+
+        remaining = []
+        for entry in app.get("draining", []):
+            replica = entry["replica"]
+            now = _t.monotonic()
+            done = now >= entry["deadline"]
+            if not done and now - entry["removed_at"] >= 6.0:
+                try:
+                    done = ray_tpu.get(replica.drain.remote(),
+                                       timeout=5.0) == 0
+                except Exception:
+                    done = True  # already dead
+            if done:
+                try:
+                    ray_tpu.kill(replica)
+                except Exception:
+                    pass
+            else:
+                remaining.append(entry)
+        app["draining"] = remaining
+        # killing a drained victim never changes the topology (it already
+        # left the replica list when the scale-down was decided)
+        return False
 
     def _advance_rollout(self, name: str, app: dict) -> bool:
         """One rolling-update step per control-loop tick (reference:
@@ -528,49 +705,113 @@ class _ServeController:
         return True
 
     def _autoscale(self, name: str):
-        """Average ongoing requests per replica vs. target, with up/down
-        delay smoothing (reference: autoscaling_policy.py)."""
+        """Demand-driven autoscaling: poll cumulative replica counters,
+        fold them into the deployment's sliding rate window, and let the
+        policy price replica demand (Little's law concurrency, hysteresis,
+        cooldown, queue-SLO pressure). Rates from counter DELTAS replace
+        the old ``take_ongoing_peak`` gauge — a burst that arrives and
+        fully drains between two 0.5s ticks still moves the cumulative
+        ``arrived`` counter, so burst blindness is covered structurally
+        instead of patched per-gauge (reference: autoscaling_state.py)."""
         import time as _t
+
+        from ray_tpu.serve.autoscale import decide
 
         app = self.apps[name]
         auto: AutoscalingConfig = app["cfg"].autoscaling
         if auto is None or not app["replicas"]:
             return
+        window = app.get("window")
+        state = app.get("policy_state")
+        if window is None or state is None:
+            return
+        # wait-then-get: a wedged or cold replica must not stall the
+        # control loop — fold in whichever samples arrived in budget
+        refs = [r.take_stats.remote() for r in app["replicas"]]
         try:
-            # peak since the last poll, not an instantaneous sample: a
-            # burst that arrives and drains entirely between two 0.5s
-            # ticks must still register as load
-            ongoing = ray_tpu.get(
-                [r.take_ongoing_peak.remote() for r in app["replicas"]],
-                timeout=10)
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=5.0)
+            stats = [ray_tpu.get(ref) for ref in ready]
         except Exception:
             return
-        avg = sum(ongoing) / max(len(ongoing), 1)
+        if not stats:
+            return
         now = _t.monotonic()
-        target = app["target"]
-        if avg > auto.target_ongoing_requests and target < auto.max_replicas:
-            app["scale_down_since"] = None
-            if app["scale_up_since"] is None:
-                app["scale_up_since"] = now
-            if now - app["scale_up_since"] >= auto.upscale_delay_s:
-                # scale to what the load implies, clamped
-                want = min(auto.max_replicas, max(
-                    target + 1,
-                    int(round(avg * len(ongoing)
-                              / auto.target_ongoing_requests))))
-                app["target"] = want
-                app["scale_up_since"] = None
-        elif (avg < auto.target_ongoing_requests * 0.5
-                and target > auto.min_replicas):
-            app["scale_up_since"] = None
-            if app["scale_down_since"] is None:
-                app["scale_down_since"] = now
-            if now - app["scale_down_since"] >= auto.downscale_delay_s:
-                app["target"] = max(auto.min_replicas, target - 1)
-                app["scale_down_since"] = None
-        else:
-            app["scale_up_since"] = None
-            app["scale_down_since"] = None
+        window.observe(stats, now)
+        slo = app.get("slo") or {}
+        decision = decide(window, current_target=app["target"], config=auto,
+                          state=state, now=now,
+                          queue_target_s=slo.get("queue_target_s"))
+        rollup = window.rollup(now)
+        self._publish_autoscale(name, app, rollup)
+        if decision.want != app["target"]:
+            before = app["target"]
+            app["target"] = decision.want
+            self._record_transition(name, before, decision)
+
+    def _record_transition(self, name: str, before: int, decision):
+        """Bounded per-app scale history + structured task-plane event +
+        timeline span, so ``ray-tpu health``/``/api/timeline`` show WHY
+        each scale action fired."""
+        import time as _t
+
+        app = self.apps[name]
+        entry = {"ts": _t.time(), "from": before, "to": decision.want,
+                 "direction": decision.direction, "reason": decision.reason,
+                 "metrics": decision.metrics}
+        transitions = app.setdefault("transitions", [])
+        transitions.append(entry)
+        del transitions[:-64]
+        try:
+            from ray_tpu.util import events, tracing
+
+            events.record(
+                "serve", "INFO",
+                "autoscale %s: %d -> %d (%s)" % (name, before, decision.want,
+                                                 decision.reason),
+                deployment=name, direction=decision.direction,
+                **decision.metrics)
+            end = _t.time()
+            tracing.record_span("serve.autoscale", end - 1e-4, end,
+                                category="serve", deployment=name,
+                                direction=decision.direction,
+                                replicas_from=before,
+                                replicas_to=decision.want)
+        except Exception:  # observability is best-effort by contract
+            pass
+
+    def _publish_autoscale(self, name: str, app: dict, rollup: dict):
+        """Per-tick observability fan-out: registry gauges (flushed into
+        the GCS metrics-history ring) + a KV ``serve`` namespace mirror
+        (dashboard ``/api/serve``, CLI, and the GCS health scan's SLO
+        check read it back)."""
+        try:
+            obs = _auto_obs()
+            tags = {"deployment": name}
+            obs["arrival"].set(rollup.get("arrival_rate") or 0.0, tags=tags)
+            obs["replicas"].set(float(len(app["replicas"])), tags=tags)
+            obs["target"].set(float(app["target"]), tags=tags)
+            qp99 = rollup.get("queue_p99_s")
+            if qp99 is not None:
+                obs["queue_p99"].set(qp99, tags=tags)
+        except Exception:
+            pass
+        try:
+            import time as _t
+
+            from ray_tpu._private import wire
+            from ray_tpu.experimental.internal_kv import _internal_kv_put
+
+            _internal_kv_put(name.encode(), wire.dumps({
+                "ts": _t.time(),
+                "target": app["target"],
+                "replicas": len(app["replicas"]),
+                "draining": len(app.get("draining", [])),
+                "slo": app.get("slo"),
+                "rollup": rollup,
+                "transitions": list(app.get("transitions", []))[-8:],
+            }), namespace="serve")
+        except Exception:  # stats mirror is best-effort by contract
+            pass
 
     def run_control_loop(self):
         """Blocking reconcile+autoscale loop; started once by serve.run
@@ -631,15 +872,41 @@ class _ServeController:
                         "replicas": list(app["replicas"])}
             await asyncio.sleep(0.1)
 
+    def get_autoscale_state(self, name: str) -> dict:
+        """Rate rollup + scale history for one deployment (CLI/dashboard/
+        bench read-back)."""
+        with self._mutate:
+            app = self.apps.get(name)
+            if app is None:
+                raise KeyError(f"no deployment named {name!r}")
+            window = app.get("window")
+            return {
+                "target": app["target"],
+                "replicas": len(app["replicas"]),
+                "draining": len(app.get("draining", [])),
+                "slo": app.get("slo"),
+                "rollup": window.rollup() if window is not None else None,
+                "transitions": list(app.get("transitions", [])),
+            }
+
     def delete(self, name: str) -> bool:
         with self._mutate:
             app = self.apps.pop(name, None)
             if app:
-                for r in app["replicas"]:
+                victims = list(app["replicas"]) + [
+                    e["replica"] for e in app.get("draining", [])]
+                for r in victims:
                     try:
                         ray_tpu.kill(r)
                     except Exception:
                         pass
+                try:
+                    from ray_tpu.experimental.internal_kv import \
+                        _internal_kv_del
+
+                    _internal_kv_del(name.encode(), namespace="serve")
+                except Exception:
+                    pass
         with self._cv:
             self._cv.notify_all()
         return True
@@ -649,13 +916,19 @@ class _ServeController:
         return True
 
     def status(self) -> Dict[str, Any]:
-        return {
-            name: {"num_replicas": len(app["replicas"]),
-                   "target": app["target"],
-                   "version": app["version"],
-                   "autoscaling": app["cfg"].autoscaling is not None}
-            for name, app in self.apps.items()
-        }
+        out = {}
+        for name, app in self.apps.items():
+            transitions = app.get("transitions") or []
+            out[name] = {
+                "num_replicas": len(app["replicas"]),
+                "target": app["target"],
+                "version": app["version"],
+                "autoscaling": app["cfg"].autoscaling is not None,
+                "draining": len(app.get("draining", [])),
+                "slo": app.get("slo"),
+                "last_transition": transitions[-1] if transitions else None,
+            }
+        return out
 
 
 def _get_controller(create: bool = True):
@@ -676,14 +949,20 @@ def _get_controller(create: bool = True):
 
 class DeploymentHandle:
     """Client-side router: power-of-two-choices over replica pending counts,
-    fed by the controller's versioned topology (long-pollable)."""
+    fed by the controller's versioned topology (long-pollable).
+    ``options(routing_policy="prefix")`` swaps keyed routing onto the
+    shared consistent-hash :class:`~ray_tpu.serve.autoscale.PrefixRouter`
+    policy (promoted from the LLMHandle one-off)."""
 
     def __init__(self, deployment_name: str, method_name: str = "__call__",
-                 multiplexed_model_id: str = "", stream: bool = False):
+                 multiplexed_model_id: str = "", stream: bool = False,
+                 routing_policy: str = "pow2"):
         self._name = deployment_name
         self._method = method_name
         self._model_id = multiplexed_model_id
         self._stream = stream
+        self._routing_policy = routing_policy
+        self._prefix_router = None
         self._replicas: List[Any] = []
         self._version = -1
         self._pending: Dict[Any, int] = {}
@@ -691,17 +970,32 @@ class DeploymentHandle:
 
     def options(self, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                routing_policy: Optional[str] = None) -> "DeploymentHandle":
+        if routing_policy is not None and routing_policy not in (
+                "pow2", "prefix"):
+            raise ValueError(
+                f"unknown routing_policy {routing_policy!r}; "
+                "expected 'pow2' or 'prefix'")
         h = DeploymentHandle(
             self._name,
             method_name if method_name is not None else self._method,
             multiplexed_model_id if multiplexed_model_id is not None
             else self._model_id,
-            stream if stream is not None else self._stream)
+            stream if stream is not None else self._stream,
+            routing_policy if routing_policy is not None
+            else self._routing_policy)
         h._replicas = self._replicas
         h._version = self._version
         h._pending = self._pending
         return h
+
+    def _router(self):
+        if self._prefix_router is None:
+            from ray_tpu.serve.autoscale import PrefixRouter
+
+            self._prefix_router = PrefixRouter(self._name)
+        return self._prefix_router
 
     def _refresh(self, force: bool = False):
         if not force and self._replicas and time.monotonic() - self._last_refresh < 5.0:
@@ -759,32 +1053,35 @@ class DeploymentHandle:
         t0 = time.perf_counter()
         with tracing.profile("serve.route", category="serve",
                              deployment=self._name):
-            replica = self._pick()
+            key = None
+            if self._routing_policy == "prefix" and args:
+                # derive the routing key from the request body's prompt
+                # prefix; non-prompt bodies fall back to pow-2
+                key = self._router().key_of(args[0])
+            replica = self._pick_keyed(key) if key else self._pick()
         _obs()["route"].observe(time.perf_counter() - t0)
         return self._dispatch(replica, args, kwargs)
 
     def remote_with_key(self, routing_key: str, *args, **kwargs):
-        """Consistent routing: the same key prefers the same replica (used by
-        prefix-aware LLM routing; falls back to pow-2 with one replica)."""
-        import hashlib
-
+        """Consistent routing: the same key prefers the same replica (the
+        prefix-cache-aware policy — see autoscale/router.py). A replica
+        joining or leaving remaps only ~1/N of the key space, so warm KV
+        prefixes survive autoscaling and rolling updates."""
         from ray_tpu.util import tracing
 
         t0 = time.perf_counter()
         with tracing.profile("serve.route", category="serve",
                              deployment=self._name):
-            self._refresh()
-            if not self._replicas:
-                replica = self._pick()  # waits for replicas / raises
-            elif len(self._replicas) > 1:
-                digest = hashlib.md5(routing_key.encode()).digest()
-                replica = self._replicas[
-                    int.from_bytes(digest[:4], "little")
-                    % len(self._replicas)]
-            else:
-                replica = self._pick()
+            replica = self._pick_keyed(routing_key)
         _obs()["route"].observe(time.perf_counter() - t0)
         return self._dispatch(replica, args, kwargs)
+
+    def _pick_keyed(self, routing_key: str):
+        self._refresh()
+        if not self._replicas or len(self._replicas) == 1:
+            return self._pick()  # waits for replicas / raises
+        return self._router().pick(routing_key, self._replicas,
+                                   version=self._version)
 
     def broadcast(self, method_name: str, *args, timeout: float = 120.0,
                   **kwargs) -> List[Any]:
@@ -820,7 +1117,8 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._name, self._method, self._model_id, self._stream))
+                (self._name, self._method, self._model_id, self._stream,
+                 self._routing_policy))
 
 
 def get_app_handle(name: str) -> DeploymentHandle:

@@ -211,6 +211,16 @@ def list_checkpoints() -> Dict[str, Any]:
     return _kv_namespace_dump("ckpt")
 
 
+def serve_state() -> Dict[str, Any]:
+    """Serve autoscale plane per deployment (reference surface: the
+    dashboard's /api/serve): replica target vs live count, windowed rate
+    rollup (arrival rate, queue p99, execute mean), registered SLO
+    targets and recent scale transitions — mirrored to GCS KV ns="serve"
+    by the serve controller every autoscale tick
+    (ray_tpu/serve/api.py _publish_autoscale)."""
+    return _kv_namespace_dump("serve")
+
+
 def list_worker_pools() -> Dict[str, Any]:
     """Per-raylet worker-pool / provisioning-plane stats (reference
     surface: the dashboard's /api/workers): zygote liveness, warm-pool

@@ -1644,6 +1644,56 @@ def test_obs001_quant_metrics_negative_pr14_shapes():
 
 
 # ---------------------------------------------------------------------------
+# OBS001 — PR 17 serve autoscale-plane instruments (arrival-rate/queue-depth
+# gauges, shed + prefix-cache counters stay prefixed + described; the
+# deployment name rides TAGS, never the metric or span name)
+# ---------------------------------------------------------------------------
+
+
+def test_obs001_serve_metrics_positive():
+    findings = lint("""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        rate = Gauge("serve_arrival_rate", "windowed arrival rate")
+        shed = Counter("ray_tpu.serve.shed_requests")
+
+        def autoscale_tick(deployment):
+            with tracing.profile(f"serve.autoscale.{deployment}"):
+                pass
+    """, rules=["OBS001"])
+    assert rules_of(findings) == ["OBS001"] * 3
+    assert "ray_tpu_" in findings[0].message      # unprefixed gauge
+    assert "description" in findings[1].message   # undescribed counter
+    assert "static string" in findings[2].message  # deployment in span name
+
+
+def test_obs001_serve_metrics_negative_pr17_shapes():
+    # the shapes the serve plane actually ships: described
+    # ray_tpu.serve.* instruments, deployment/reason as tags
+    findings = lint("""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        rate = Gauge("ray_tpu.serve.arrival_rate",
+                     "windowed request arrival rate per deployment (req/s)")
+        depth = Gauge("ray_tpu.serve.queue_depth",
+                      "requests waiting in the ingress fair queue")
+        shed = Counter("ray_tpu.serve.shed_requests",
+                       "requests rejected by SLO admission control")
+        hits = Counter("ray_tpu.serve.prefix_cache_hits",
+                       "prefix-routed requests that stayed on the replica "
+                       "owning their prompt prefix")
+
+        def autoscale_tick(deployment, direction):
+            with tracing.profile("serve.autoscale", category="serve",
+                                 deployment=deployment, direction=direction):
+                pass
+    """, rules=["OBS001"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # RCE001 — shared-state race across disjoint execution contexts
 # ---------------------------------------------------------------------------
 

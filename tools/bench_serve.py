@@ -1,0 +1,268 @@
+"""Sustained-load serving benchmark (``python -m tools.bench_serve``).
+
+Closes the serving loop end to end: an open-loop Poisson arrival process
+(arrivals fire on the clock whether or not earlier requests finished — the
+load model that exposes queueing collapse, unlike closed-loop ramps) drives
+a streaming token deployment under the demand-driven autoscaler, through
+three phases:
+
+* **burst** — high arrival rate; the rate window must price the demand and
+  scale UP (``scaled_up``), while a ROLLING weight update runs concurrently
+  (redeploy → max-surge-1 replica replacement with drain-before-kill) and
+  no request may drop;
+* **drain** — low arrival rate; demand decays through the hysteresis band
+  and the deployment must scale DOWN (``scaled_down``);
+* the controller's transition timeline (reason + window metrics per scale
+  action) is captured verbatim into the artifact.
+
+Reported: p50/p99 TTFT (client-observed first streamed token), p50/p99
+completion latency, aggregate tokens/s, per-phase arrival rates, the
+autoscale transition timeline, and ``dropped_requests`` (acceptance bar:
+**zero** across the rolling update). Emits one JSON object on stdout
+(plus ``--out FILE``) — checked in as ``SERVE_r01.json``.
+
+``--smoke`` shrinks rates/durations for the tier-1 wrapper
+(tests/test_serve_autoscale.py::test_bench_serve_smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+DEPLOYMENT = "bench_serve_tokens"
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def _build_app(serve, *, max_replicas: int, window_s: float,
+               downscale_delay_s: float, token_delay_s: float):
+    @serve.deployment(
+        name=DEPLOYMENT,
+        max_ongoing_requests=64,
+        autoscaling_config={
+            "min_replicas": 1, "max_replicas": max_replicas,
+            "target_ongoing_requests": 2.0,
+            "upscale_delay_s": 0.5, "downscale_delay_s": downscale_delay_s,
+            "window_s": window_s, "scale_cooldown_s": 0.5,
+        },
+        slo={"queue_target_s": 0.5},
+        ray_actor_options={"num_cpus": 0.25})
+    class TokenServer:
+        def __init__(self, version: int = 0):
+            self._weights_version = version
+
+        def update_weights(self, version: int) -> int:
+            self._weights_version = version
+            return version
+
+        async def __call__(self, body):
+            import asyncio
+
+            for _ in range(int(body.get("tokens", 8))):
+                await asyncio.sleep(token_delay_s)
+            return {"tokens": int(body.get("tokens", 8)),
+                    "weights_version": self._weights_version}
+
+        async def stream(self, body):
+            import asyncio
+
+            for i in range(int(body.get("tokens", 8))):
+                await asyncio.sleep(token_delay_s)
+                yield {"token": i, "weights_version": self._weights_version}
+
+    return TokenServer
+
+
+class _LoadGenerator:
+    """Open-loop Poisson client: one dispatcher thread fires requests on
+    the drawn arrival clock; each request runs on its own thread so a slow
+    response never holds back the arrival process."""
+
+    def __init__(self, ray_tpu, handle, tokens_per_request: int):
+        self.ray_tpu = ray_tpu
+        self.handle = handle
+        self.tokens = tokens_per_request
+        self.lock = threading.Lock()
+        self.ttft_s: list = []
+        self.latency_s: list = []
+        self.tokens_out = 0
+        self.dropped: list = []
+        self._threads: list = []
+
+    def _one(self, stream: bool):
+        t0 = time.monotonic()
+        body = {"tokens": self.tokens}
+        try:
+            if stream:
+                gen = self.handle.options(
+                    method_name="stream", stream=True).remote(body)
+                first = self.ray_tpu.get(next(gen), timeout=120)
+                ttft = time.monotonic() - t0
+                n = 1
+                for ref in gen:
+                    self.ray_tpu.get(ref, timeout=120)
+                    n += 1
+                assert first["token"] == 0
+            else:
+                out = self.ray_tpu.get(self.handle.remote(body), timeout=120)
+                ttft = time.monotonic() - t0
+                n = out["tokens"]
+            latency = time.monotonic() - t0
+            with self.lock:
+                self.ttft_s.append(ttft)
+                self.latency_s.append(latency)
+                self.tokens_out += n
+        except Exception as e:
+            with self.lock:
+                self.dropped.append(f"{type(e).__name__}: {e}")
+
+    def run_phase(self, rate_hz: float, duration_s: float, *,
+                  stream_every: int = 4, seed: int = 0) -> int:
+        """Fire Poisson arrivals at ``rate_hz`` for ``duration_s``; every
+        ``stream_every``-th request uses the streaming path (client-observed
+        TTFT), the rest the unary path (keeps thread count bounded)."""
+        import random as _random
+
+        rng = _random.Random(seed)
+        end = time.monotonic() + duration_s
+        fired = 0
+        next_at = time.monotonic()
+        while next_at < end:
+            delay = next_at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(
+                target=self._one, args=(fired % stream_every == 0,),
+                daemon=True)
+            th.start()
+            self._threads.append(th)
+            fired += 1
+            next_at += rng.expovariate(rate_hz)
+        return fired
+
+    def join(self, timeout_s: float = 180.0):
+        deadline = time.monotonic() + timeout_s
+        for th in self._threads:
+            th.join(max(0.1, deadline - time.monotonic()))
+        still = sum(1 for th in self._threads if th.is_alive())
+        if still:
+            with self.lock:
+                self.dropped.append(f"{still} requests unfinished at join")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny rates/durations for the tier-1 wrapper")
+    args = parser.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve import api as serve_api
+
+    # demand math: concurrency = rate x (tokens x token_delay_s); the
+    # burst must price to >1 replica (target_ongoing=2) and the drain to
+    # well under the hysteresis band
+    if args.smoke:
+        burst_rate, burst_s = 40.0, 4.0
+        drain_rate, drain_s = 1.0, 6.0
+        settle_s, window_s, downscale_delay_s = 8.0, 4.0, 1.0
+        tokens, token_delay_s, max_replicas = 8, 0.02, 3
+    else:
+        burst_rate, burst_s = 60.0, 8.0
+        drain_rate, drain_s = 2.0, 10.0
+        settle_s, window_s, downscale_delay_s = 14.0, 5.0, 2.0
+        tokens, token_delay_s, max_replicas = 8, 0.02, 6
+
+    ray_tpu.init(num_cpus=8)
+    dep = _build_app(serve, max_replicas=max_replicas, window_s=window_s,
+                     downscale_delay_s=downscale_delay_s,
+                     token_delay_s=token_delay_s)
+    handle = serve.run(dep.bind(0), name=DEPLOYMENT)
+    ray_tpu.get([handle.remote({"tokens": 1}) for _ in range(4)],
+                timeout=120)  # warm
+
+    gen = _LoadGenerator(ray_tpu, handle, tokens)
+    t_start = time.time()
+
+    # rolling weight update mid-burst: redeploy with a new init argument
+    # (code_version bump → max-surge-1 replica replacement with
+    # drain-before-kill) — the zero-drop criterion covers this window
+    def _rolling_update():
+        time.sleep(burst_s * 0.3)
+        serve.run(dep.bind(1), name=DEPLOYMENT)
+
+    updater = threading.Thread(target=_rolling_update, daemon=True)
+    updater.start()
+
+    fired_burst = gen.run_phase(burst_rate, burst_s, seed=1)
+    updater.join(timeout=60.0)
+    fired_drain = gen.run_phase(drain_rate, drain_s, seed=2)
+    gen.join()
+
+    # let the window decay so the downscale path fires before read-back
+    controller = serve_api._get_controller(create=False)
+    deadline = time.monotonic() + settle_s + 30.0
+    state = {}
+    while time.monotonic() < deadline:
+        state = ray_tpu.get(
+            controller.get_autoscale_state.remote(DEPLOYMENT), timeout=30)
+        if any(t["direction"] == "down" for t in state["transitions"]) \
+                and state["target"] == 1:
+            break
+        time.sleep(0.5)
+
+    wall_s = time.time() - t_start
+    ttft = sorted(gen.ttft_s)
+    latency = sorted(gen.latency_s)
+    transitions = [
+        {"t_s": round(t["ts"] - t_start, 3), "from": t["from"],
+         "to": t["to"], "direction": t["direction"], "reason": t["reason"],
+         "metrics": t["metrics"]}
+        for t in state.get("transitions", [])]
+    verified = ray_tpu.get(handle.remote({"tokens": 1}), timeout=60)
+    out = {
+        "mode": "smoke" if args.smoke else "full",
+        "requests_fired": fired_burst + fired_drain,
+        "requests_completed": len(latency),
+        "dropped_requests": len(gen.dropped),
+        "dropped_detail": gen.dropped[:10],
+        "burst_rate_hz": burst_rate,
+        "drain_rate_hz": drain_rate,
+        "ttft_p50_ms": (_percentile(ttft, 0.5) or 0) * 1e3,
+        "ttft_p99_ms": (_percentile(ttft, 0.99) or 0) * 1e3,
+        "latency_p50_ms": (_percentile(latency, 0.5) or 0) * 1e3,
+        "latency_p99_ms": (_percentile(latency, 0.99) or 0) * 1e3,
+        "tokens_per_s": gen.tokens_out / max(wall_s, 1e-9),
+        "tokens_total": gen.tokens_out,
+        "scaled_up": any(t["direction"] == "up" for t in transitions),
+        "scaled_down": any(t["direction"] == "down" for t in transitions),
+        "max_target": max([t["to"] for t in transitions], default=1),
+        "final_target": state.get("target"),
+        "rolling_update_weights_version": verified["weights_version"],
+        "transitions": transitions,
+        "final_rollup": state.get("rollup"),
+        "wall_s": wall_s,
+    }
+
+    serve.delete(DEPLOYMENT)
+    ray_tpu.shutdown()
+
+    print(json.dumps(out, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=2)
+    return out
+
+
+if __name__ == "__main__":
+    main()
