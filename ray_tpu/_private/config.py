@@ -186,6 +186,20 @@ _d("health_stuck_fallback_s", 600.0)  # no completed samples for the fn yet
 _d("health_straggler_factor", 3.0)   # outlier if > factor * cluster median
 _d("health_warn_interval_s", 60.0)   # rate limit for health warning logs
 
+# --- goodput ledger (per-job wall-clock attribution) ---
+_d("goodput_enabled", True)
+# findings ignore jobs with less than this much ledger wall time (startup
+# transients would otherwise trip the fraction thresholds)
+_d("goodput_min_wall_s", 5.0)
+_d("goodput_recompile_storm_n", 3)     # recompiles within the window ->
+_d("goodput_recompile_window_s", 300.0)  # recompile_storm finding
+_d("goodput_input_bound_frac", 0.25)   # input_stall/wall over this -> finding
+_d("goodput_ckpt_budget_s", 5.0)       # mean ckpt pause per save budget
+# goodput_fraction this far (absolute) below the job's trailing-window
+# mean -> goodput_regression finding; needs this many history points
+_d("goodput_regression_drop", 0.1)
+_d("goodput_regression_min_points", 6)
+
 # --- train / libs ---
 _d("train_health_check_period_s", 1.0)
 _d("serve_proxy_port", 8000)

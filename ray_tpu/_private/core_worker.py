@@ -1011,20 +1011,38 @@ class CoreWorker:
 
     async def _publish_metrics(self):
         """Push this process's metrics registry to the GCS KV (metrics
-        namespace); the dashboard's /metrics aggregates all processes."""
+        namespace); the dashboard's /metrics aggregates all processes.
+        The goodput ledger rides the same cadence into ns="goodput" (and
+        the flush itself is billed to the ledger's overhead bucket)."""
+        from ray_tpu.util import goodput
         from ray_tpu.util.metrics import scrape_metrics
 
+        t0 = time.perf_counter()
+        # the ledger flush first: flush_payload() mirrors the derived
+        # gauges onto the registry, so the scrape below carries them
+        gp = goodput.flush_payload(node=self.node_hex)
+        if gp is not None:
+            try:
+                await self._gcs_call("KVPut", {
+                    "ns": "goodput", "key": f"proc_{_obs_proc_tag()}",
+                    "value": wire.dumps(gp)})
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("goodput publish failed (will retry): %s", e)
         snap = scrape_metrics()
-        if not snap:
-            return
-        payload = {"pid": os.getpid(), "time": time.time(),
-                   "node": self.node_hex, "metrics": snap}
-        try:
-            await self._gcs_call("KVPut", {
-                "ns": "metrics", "key": f"proc_{_obs_proc_tag()}",
-                "value": wire.dumps(payload)})
-        except (RpcError, asyncio.TimeoutError, OSError) as e:
-            logger.debug("metrics publish failed (will retry): %s", e)
+        if snap:
+            payload = {"pid": os.getpid(), "time": time.time(),
+                       "node": self.node_hex, "metrics": snap}
+            try:
+                await self._gcs_call("KVPut", {
+                    "ns": "metrics", "key": f"proc_{_obs_proc_tag()}",
+                    "value": wire.dumps(payload)})
+            except (RpcError, asyncio.TimeoutError, OSError) as e:
+                logger.debug("metrics publish failed (will retry): %s", e)
+        if gp is not None:
+            # observability's own cost, attributed only while a ledger is
+            # active (an idle util proc should not anchor one just for
+            # its flush loop)
+            goodput.add("overhead", time.perf_counter() - t0)
 
     async def _refcount_sweep(self):
         last_reassert = time.monotonic()

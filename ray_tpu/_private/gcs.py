@@ -684,6 +684,161 @@ class MetricsHistory:
                 "points": points}
 
 
+class GoodputLedger:
+    """GCS-side per-job goodput aggregation (``util/goodput.py`` is the
+    process-side half).
+
+    Every process with an active ledger flushes a CUMULATIVE payload —
+    bucket seconds, counters, wall time — into KV ns ``goodput`` on the
+    metrics cadence; the same ``_observe_kv`` tap that feeds
+    ``MetricsHistory`` lands them here. A job's view sums the latest
+    payload of every process tagged with it, deriving
+    ``goodput_fraction`` (step_compute share of summed wall). Finished
+    jobs keep their final ledgers (bounded LRU) so ``/api/goodput`` can
+    still explain a completed run; the health scanner's
+    :meth:`findings` pass also maintains the per-job trailing windows
+    behind the recompile-storm and goodput-regression findings."""
+
+    STALE_S = 120.0       # a proc not flushing for this long is not fresh
+    MAX_JOBS = 64         # finished-job LRU bound
+    HISTORY_POINTS = 240  # per-job trailing-window ring (scan cadence)
+
+    def __init__(self):
+        # job -> proc kv-key -> latest cumulative payload
+        self._jobs: Dict[str, Dict[str, dict]] = {}
+        self._fraction_hist: Dict[str, deque] = {}
+        self._recompile_hist: Dict[str, deque] = {}
+
+    # -- ingestion ------------------------------------------------------
+
+    def observe(self, key: str, payload: dict):
+        if not isinstance(payload, dict) or "buckets" not in payload:
+            return
+        job = str(payload.get("job") or "") or "(untagged)"
+        # a process belongs to one job at a time: a re-tagged worker's
+        # old entry must not keep inflating the previous job
+        for j, procs in self._jobs.items():
+            if j != job:
+                procs.pop(key, None)
+        procs = self._jobs.pop(job, {})
+        self._jobs[job] = procs  # move-to-end: dict order is the LRU
+        procs[key] = payload
+        while len(self._jobs) > self.MAX_JOBS:
+            evicted = next(iter(self._jobs))
+            del self._jobs[evicted]
+            self._fraction_hist.pop(evicted, None)
+            self._recompile_hist.pop(evicted, None)
+
+    # -- reads ----------------------------------------------------------
+
+    def _job_view(self, job: str, procs: Dict[str, dict],
+                  now: float) -> dict:
+        buckets: Dict[str, float] = {}
+        counters: Dict[str, float] = {}
+        wall = 0.0
+        mfu = None
+        nodes = set()
+        fresh = 0
+        last_update = 0.0
+        for p in procs.values():
+            for b, v in (p.get("buckets") or {}).items():
+                if isinstance(v, (int, float)):
+                    buckets[b] = buckets.get(b, 0.0) + float(v)
+            for c, v in (p.get("counters") or {}).items():
+                if isinstance(v, (int, float)):
+                    counters[c] = counters.get(c, 0) + v
+            wall += float(p.get("wall_s") or 0.0)
+            if isinstance(p.get("mfu"), (int, float)):
+                mfu = max(mfu if mfu is not None else 0.0, float(p["mfu"]))
+            if p.get("node"):
+                nodes.add(str(p["node"])[:16])
+            ts = float(p.get("time") or 0.0)
+            last_update = max(last_update, ts)
+            if now - ts <= self.STALE_S:
+                fresh += 1
+        view = {
+            "job": job, "wall_s": wall, "buckets": buckets,
+            "counters": counters,
+            "goodput_fraction": (buckets.get("step_compute", 0.0) / wall
+                                 if wall > 0 else 0.0),
+            "procs": len(procs), "fresh_procs": fresh,
+            "nodes": sorted(nodes), "last_update": last_update,
+        }
+        if mfu is not None:
+            view["mfu"] = mfu
+        return view
+
+    def jobs(self, now: Optional[float] = None) -> Dict[str, dict]:
+        now = time.time() if now is None else now
+        return {job: self._job_view(job, procs, now)
+                for job, procs in self._jobs.items() if procs}
+
+    # -- health findings ------------------------------------------------
+
+    def findings(self, now: float, cfg) -> List[dict]:
+        """One health-scan pass over every job with fresh reporters:
+        recompile storms (recompile count within the trailing window),
+        input-bound jobs (input_stall share of wall), checkpoint pauses
+        over budget (mean pause per save), and goodput regression vs
+        the job's OWN trailing-window mean. Also appends this scan's
+        point to the per-job trailing rings."""
+        out: List[dict] = []
+        for job, view in self.jobs(now).items():
+            if view["fresh_procs"] == 0:
+                continue  # finished/stale job: freeze, never re-warn
+            wall = view["wall_s"]
+            buckets = view["buckets"]
+            counters = view["counters"]
+            fraction = view["goodput_fraction"]
+            rc_hist = self._recompile_hist.setdefault(
+                job, deque(maxlen=self.HISTORY_POINTS))
+            fr_hist = self._fraction_hist.setdefault(
+                job, deque(maxlen=self.HISTORY_POINTS))
+            if wall >= cfg.goodput_min_wall_s:
+                # recompile storm: recompiles accumulated inside the
+                # window (vs the oldest in-window history point; with no
+                # history yet the lifetime total is the window)
+                recompiles = counters.get("recompiles", 0)
+                cutoff = now - cfg.goodput_recompile_window_s
+                base = next((v for ts, v in rc_hist if ts >= cutoff), None)
+                recent = recompiles - base if base is not None else recompiles
+                if recent >= cfg.goodput_recompile_storm_n:
+                    out.append({
+                        "kind": "recompile_storm", "severity": "warning",
+                        "job": job, "recompiles_in_window": recent,
+                        "window_s": cfg.goodput_recompile_window_s,
+                        "compiles_total": counters.get("compiles", 0),
+                        "compile_s": buckets.get("compile", 0.0)})
+                stall_frac = buckets.get("input_stall", 0.0) / wall
+                if stall_frac > cfg.goodput_input_bound_frac:
+                    out.append({
+                        "kind": "input_bound", "severity": "warning",
+                        "job": job, "input_stall_fraction": stall_frac,
+                        "threshold": cfg.goodput_input_bound_frac,
+                        "input_stall_s": buckets.get("input_stall", 0.0)})
+                saves = counters.get("ckpt_saves", 0)
+                pause = buckets.get("ckpt_pause", 0.0)
+                if saves > 0 and pause / saves > cfg.goodput_ckpt_budget_s:
+                    out.append({
+                        "kind": "ckpt_pause_over_budget",
+                        "severity": "warning", "job": job,
+                        "mean_pause_s": pause / saves, "saves": saves,
+                        "budget_s": cfg.goodput_ckpt_budget_s})
+                if len(fr_hist) >= cfg.goodput_regression_min_points:
+                    trailing = sum(v for _, v in fr_hist) / len(fr_hist)
+                    if trailing - fraction > cfg.goodput_regression_drop:
+                        out.append({
+                            "kind": "goodput_regression",
+                            "severity": "warning", "job": job,
+                            "goodput_fraction": fraction,
+                            "trailing_mean": trailing,
+                            "drop": trailing - fraction,
+                            "threshold": cfg.goodput_regression_drop})
+            rc_hist.append((now, counters.get("recompiles", 0)))
+            fr_hist.append((now, fraction))
+        return out
+
+
 def build_timeline(records: List[dict], spans: Optional[List[dict]] = None,
                    start_ts: Optional[float] = None,
                    end_ts: Optional[float] = None) -> dict:
@@ -833,6 +988,8 @@ class GcsServer:
         # cluster health plane: metrics time-series history + the
         # stuck/straggler scanner's latest report
         self.metrics_history = MetricsHistory()
+        # per-job goodput aggregation over the workers' ledger payloads
+        self.goodput_ledger = GoodputLedger()
         self._health: dict = {"ts": 0.0, "status": "unknown",
                               "findings": [], "scan_count": 0}
         self._health_warn_ts: Dict[tuple, float] = {}
@@ -1147,14 +1304,19 @@ class GcsServer:
         return {"added": True}
 
     def _observe_kv(self, ns: str, key: str, value):
-        """Tap metric-snapshot puts into the history ring (the reporters
-        keep their single KV write; history costs them nothing)."""
-        if ns != "metrics":
-            return
-        try:
-            self.metrics_history.observe_payload(key, wire.loads(value))
-        except Exception as e:
-            logger.debug("undecodable metrics payload %s: %s", key, e)
+        """Tap metric-snapshot and goodput-ledger puts into their
+        aggregators (the reporters keep their single KV write; history
+        costs them nothing)."""
+        if ns == "metrics":
+            try:
+                self.metrics_history.observe_payload(key, wire.loads(value))
+            except Exception as e:
+                logger.debug("undecodable metrics payload %s: %s", key, e)
+        elif ns == "goodput":
+            try:
+                self.goodput_ledger.observe(key, wire.loads(value))
+            except Exception as e:
+                logger.debug("undecodable goodput payload %s: %s", key, e)
 
     async def _rpc_KVGet(self, req, conn):
         return {"value": self.kv.get((req.get("ns", ""), req["key"]))}
@@ -2264,6 +2426,22 @@ class GcsServer:
                     "value": exec_mean, "target": latency_budget,
                     "replicas": entry.get("replicas"),
                     "replica_target": entry.get("target")})
+            ttft_target = slo.get("ttft_target_s")
+            ttft_p99 = rollup.get("ttft_p99_s")
+            if (ttft_target is not None and ttft_p99 is not None
+                    and ttft_p99 > ttft_target):
+                findings.append({
+                    "kind": "serve_slo_violation", "severity": "warning",
+                    "deployment": dep, "metric": "ttft_p99_s",
+                    "value": ttft_p99, "target": ttft_target,
+                    "replicas": entry.get("replicas"),
+                    "replica_target": entry.get("target")})
+
+        # -- goodput ledger ---------------------------------------------
+        # per-job wall-clock attribution pathologies: recompile storms,
+        # input-bound steps, over-budget checkpoint pauses, and goodput
+        # regression vs the job's own trailing window
+        findings.extend(self.goodput_ledger.findings(now, cfg))
 
         status = "ok"
         if any(f["severity"] == "error" for f in findings):
@@ -2280,7 +2458,8 @@ class GcsServer:
         # identity per health_warn_interval_s, not one per scan)
         for f in findings:
             ident = (f["kind"], f.get("node", ""), f.get("task_id", ""),
-                     f.get("deployment", ""), f.get("metric", ""))
+                     f.get("deployment", ""), f.get("metric", ""),
+                     f.get("job", ""))
             if now - self._health_warn_ts.get(ident, 0.0) \
                     < cfg.health_warn_interval_s:
                 continue
@@ -2301,6 +2480,15 @@ class GcsServer:
         if req.get("scan") or not self._health.get("scan_count"):
             await self._health_scan()
         return {"health": self._health}
+
+    async def _rpc_GetGoodput(self, req, conn):
+        """Per-job goodput ledgers (``/api/goodput`` /
+        ``util.state.goodput()`` / ``ray-tpu goodput``)."""
+        jobs = self.goodput_ledger.jobs()
+        job = req.get("job")
+        if job:
+            jobs = {job: jobs[job]} if job in jobs else {}
+        return {"jobs": jobs}
 
     # ------------------------------------------------------------------
     # debug / state api

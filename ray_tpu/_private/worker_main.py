@@ -35,11 +35,12 @@ def reset_observability_after_fork() -> None:
     fork bug, PR 8). Called by the zygote's fork child before
     :func:`run_worker`; safe to call in any process."""
     from ray_tpu._private import task_events
-    from ray_tpu.util import metrics, tracing
+    from ray_tpu.util import goodput, metrics, tracing
 
     task_events.reset_after_fork()
     tracing.reset_after_fork()
     metrics.reset_after_fork()
+    goodput.reset_after_fork()
 
 
 def run_worker(raylet_address: str, gcs_address: str, node_id_hex: str,

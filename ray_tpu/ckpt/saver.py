@@ -207,15 +207,20 @@ class CheckpointSaver:
         ``store.wait_for``). ``spec`` (a ``ShardedTreeSpec``) records the
         shard geometry and splits leaves into per-box chunks; without it
         the tree is saved as one full-extent chunk per leaf."""
-        from ray_tpu.util import tracing
+        from ray_tpu.util import goodput, tracing
 
         with self._lock:
-            self._drain_locked()  # backpressure + surface prior errors
-            t0 = time.monotonic()
-            with tracing.profile("ckpt.snapshot", category="ckpt",
-                                 step=step):
-                skeleton, snap = snapshot_tree(tree)
-            pause_s = time.monotonic() - t0
+            # the whole caller-thread window — waiting out a prior
+            # in-flight commit plus the synchronous snapshot — is what
+            # the train loop experiences as the checkpoint pause
+            with goodput.region("ckpt_pause"):
+                self._drain_locked()  # backpressure + surface prior errors
+                t0 = time.monotonic()
+                with tracing.profile("ckpt.snapshot", category="ckpt",
+                                     step=step):
+                    skeleton, snap = snapshot_tree(tree)
+                pause_s = time.monotonic() - t0
+            goodput.count("ckpt_saves")
             _obs()["pause"].observe(pause_s)
             ckpt_id = mf.new_ckpt_id(step)
             parent = self.store.latest_id()

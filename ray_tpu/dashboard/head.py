@@ -16,6 +16,7 @@ Endpoints:
   GET  /api/workers          - per-node worker-pool / provisioning stats
   GET  /api/timeline         - Perfetto chrome-trace of the task flow graph
   GET  /api/health           - cluster-health report (stuck/straggler scan)
+  GET  /api/goodput          - per-job goodput ledgers (wall-clock buckets)
   GET  /api/metrics/history  - metric time-series (raw + rollup tiers)
   GET  /metrics              - Prometheus text exposition
   GET  /api/jobs             - submitted jobs (job manager KV)
@@ -93,6 +94,7 @@ class DashboardHead:
             web.get("/api/tasks/summary", self._tasks_summary),
             web.get("/api/timeline", self._timeline),
             web.get("/api/health", self._health),
+            web.get("/api/goodput", self._goodput),
             web.get("/api/metrics/history", self._metrics_history),
             web.get("/api/workers", self._workers),
             web.get("/metrics", self._prometheus),
@@ -315,6 +317,18 @@ class DashboardHead:
         scan = request.query.get("scan", "0") not in ("0", "false", "")
         reply = await self._call("GetClusterHealth", {"scan": scan})
         return web.json_response(reply["health"])
+
+    async def _goodput(self, request):
+        """Per-job goodput ledgers: cumulative wall-clock attribution
+        buckets, counters, and the derived goodput_fraction.
+        ``?job=<run name>`` filters to one job."""
+        from aiohttp import web
+
+        req = {}
+        if request.query.get("job"):
+            req["job"] = request.query["job"]
+        reply = await self._call("GetGoodput", req)
+        return web.json_response(reply["jobs"])
 
     async def _metrics_history(self, request):
         """Metric time-series from the GCS history ring. Query params:

@@ -413,9 +413,17 @@ class Dataset:
         t = _th.Thread(target=_producer, daemon=True,
                        name="ray_tpu-device-prefetch")
         t.start()
+        from ray_tpu.util import goodput
+
         try:
             while True:
-                item = q.get()
+                # consumer-side queue wait IS the input stall: with the
+                # prefetch pipeline keeping up this get returns
+                # immediately; time spent blocked here is wall the step
+                # loop lost to input
+                with goodput.region("input_stall"):
+                    item = q.get()
+                goodput.count("input_waits")
                 if item is _END:
                     break
                 if isinstance(item, tuple) and len(item) == 2 \

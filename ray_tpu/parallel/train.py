@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import nullcontext as _nullcontext
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -365,6 +366,15 @@ class TrainStepBundle:
             return lm_loss(logits, batch["targets"], batch.get("mask"))
 
         self.eval_step = jax.jit(eval_step)
+
+        # shape/dtype-keyed compile detection for the goodput ledger: a
+        # batch key this bundle has not dispatched before means jit will
+        # block the call through trace+lower+compile — that wall time is
+        # ``compile``, not ``step_compute``, and a NEW key on a warm
+        # program is the recompile(-storm) signal
+        from ray_tpu.util import goodput as _goodput
+
+        self._compile_watch = _goodput.CompileWatch()
 
     # -- sharding helpers -------------------------------------------------
 
@@ -717,7 +727,7 @@ class TrainStepBundle:
         phase programs under a ``train.step`` span tree — including
         per-bucket ``train.bucket_allreduce`` spans on the sharded
         path — so Perfetto shows where the step time goes."""
-        from ray_tpu.util import tracing
+        from ray_tpu.util import goodput, tracing
 
         t0 = time.perf_counter()
         if not tracing.enabled():
@@ -735,31 +745,68 @@ class TrainStepBundle:
                     "(RAY_TPU_ENABLE_TRACING=1)", self._codec.spec())
             fn = (self._fused_step_sharded if self.shard_update
                   else self._fused_step)
-            out = fn(params, opt_state, batch)
+            program = "fused_sharded" if self.shard_update else "fused"
+            out = self._dispatch_attributed(program, fn, params, opt_state,
+                                            batch)
             _obs()["step"].observe(time.perf_counter() - t0)
             return out
         if (self.shard_update and self._explicit_ok
                 and batch.get("mask") is not None):
-            out = self._step_traced_sharded(params, opt_state, batch)
+            out = self._dispatch_attributed(
+                "traced_sharded", self._step_traced_sharded, params,
+                opt_state, batch)
             _obs()["step"].observe(time.perf_counter() - t0)
             return out
         jax = import_jax()
         obs = _obs()
         fwd = self._fwd_bwd_rs if self.shard_update else self._fwd_bwd
         opt = self._opt_apply_sharded if self.shard_update else self._opt_apply
-        with tracing.profile("train.step", category="train"):
-            with tracing.profile("train.fwd_bwd", category="train"):
-                t1 = time.perf_counter()
-                loss, grads = fwd(params, batch)
-                jax.block_until_ready(grads)
-                obs["fwd_bwd"].observe(time.perf_counter() - t1)
-            with tracing.profile("train.optimizer", category="train"):
-                t2 = time.perf_counter()
-                params, opt_state = opt(grads, opt_state, params)
-                jax.block_until_ready(params)
-                obs["optimizer"].observe(time.perf_counter() - t2)
+        kind = self._compile_watch.observe(
+            "phases_rs" if self.shard_update else "phases",
+            goodput.batch_key(batch))
+        with goodput.region("step_compute"), \
+                goodput.region("compile") if kind else _nullcontext():
+            with tracing.profile("train.step", category="train"):
+                with tracing.profile("train.fwd_bwd", category="train"):
+                    t1 = time.perf_counter()
+                    loss, grads = fwd(params, batch)
+                    jax.block_until_ready(grads)
+                    obs["fwd_bwd"].observe(time.perf_counter() - t1)
+                with tracing.profile("train.optimizer", category="train"):
+                    t2 = time.perf_counter()
+                    params, opt_state = opt(grads, opt_state, params)
+                    jax.block_until_ready(params)
+                    obs["optimizer"].observe(time.perf_counter() - t2)
+        goodput.count("steps")
+        if kind:
+            goodput.count("compiles")
+            if kind == "recompile":
+                goodput.count("recompiles")
         obs["step"].observe(time.perf_counter() - t0)
         return params, opt_state, loss
+
+    def _dispatch_attributed(self, program, fn, params, opt_state, batch):
+        """Dispatch one step program under the goodput ledger:
+        ``step_compute`` normally; a compile-watch miss (new batch
+        shape/dtype key) routes the call — which jit blocks through
+        trace+lower+compile — into the nested ``compile`` bucket, with
+        the outputs synced so compile wall time is fully captured."""
+        from ray_tpu.util import goodput
+
+        kind = self._compile_watch.observe(program, goodput.batch_key(batch))
+        with goodput.region("step_compute"):
+            if kind is None:
+                out = fn(params, opt_state, batch)
+            else:
+                with goodput.region("compile"):
+                    out = fn(params, opt_state, batch)
+                    import_jax().block_until_ready(out)
+        goodput.count("steps")
+        if kind:
+            goodput.count("compiles")
+            if kind == "recompile":
+                goodput.count("recompiles")
+        return out
 
     def make_batch(self, rng: np.random.Generator, batch_size: int, seq_len: int):
         """Synthetic LM batch (tokens/targets/mask) laid out for the mesh."""

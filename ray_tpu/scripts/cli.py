@@ -163,6 +163,43 @@ def cmd_health(args):
         print("  no findings")
 
 
+def cmd_goodput(args):
+    """ray-tpu goodput: per-job wall-clock attribution ledgers — where
+    each job's seconds went (step_compute, collective_wait, input_stall,
+    ckpt_pause, compile, reform_downtime, bubble, overhead, idle) plus
+    the derived goodput_fraction; same payload as ``GET /api/goodput``."""
+    _connect(args)
+    from ray_tpu.util.state import goodput as state_goodput
+
+    jobs = state_goodput(job=args.job or None)
+    if args.json:
+        print(json.dumps(jobs, indent=2, default=str))
+        return
+    if not jobs:
+        print("no goodput ledgers (no tagged jobs have reported yet)")
+        return
+    for name, view in jobs.items():
+        wall = view.get("wall_s", 0.0)
+        frac = view.get("goodput_fraction", 0.0)
+        mfu = view.get("mfu")
+        head = (f"{name}: wall {wall:.1f}s  goodput {frac:.1%}  "
+                f"procs {view.get('fresh_procs', 0)}/{view.get('procs', 0)}")
+        if mfu is not None:
+            head += f"  mfu {mfu:.3f}"
+        print(head)
+        buckets = view.get("buckets", {})
+        for bucket, secs in sorted(buckets.items(),
+                                   key=lambda kv: -kv[1]):
+            if secs <= 0:
+                continue
+            share = secs / wall if wall > 0 else 0.0
+            print(f"  {bucket:16} {secs:10.2f}s  {share:6.1%}")
+        counters = view.get("counters", {})
+        if counters:
+            print("  counters: " + " ".join(
+                f"{k}={counters[k]:g}" for k in sorted(counters)))
+
+
 def cmd_events(args):
     """ray-tpu events: recent structured cluster events (reference: the
     export-event pipeline surfaced by the dashboard aggregator)."""
@@ -391,6 +428,12 @@ def main(argv=None):
                    help="force a scan now instead of the last periodic one")
     p.add_argument("--json", action="store_true", help="raw JSON output")
     p.set_defaults(fn=cmd_health)
+
+    p = sub.add_parser("goodput", help="per-job goodput ledgers "
+                                       "(wall-clock attribution buckets)")
+    p.add_argument("--job", default="", help="filter to one run name")
+    p.add_argument("--json", action="store_true", help="raw JSON output")
+    p.set_defaults(fn=cmd_goodput)
 
     p = sub.add_parser("events", help="recent structured cluster events")
     p.add_argument("--source", default="")

@@ -59,6 +59,11 @@ def _obs() -> dict:
                 "requests": Counter(
                     "ray_tpu.serve.requests",
                     "requests executed by this replica process"),
+                "ttft": Histogram(
+                    "ray_tpu.serve.ttft_seconds",
+                    "server-side time to first token: handle dispatch to "
+                    "the replica's first response chunk (whole response "
+                    "for unary calls)", boundaries=bounds),
             }
         return _obs_metrics
 
@@ -88,6 +93,10 @@ def _auto_obs() -> dict:
                 "queue_p99": Gauge(
                     "ray_tpu.serve.queue_wait_p99_seconds",
                     "windowed p99 queue wait per deployment"),
+                "ttft_p99": Gauge(
+                    "ray_tpu.serve.ttft_p99_seconds",
+                    "windowed p99 server-side time to first token per "
+                    "deployment"),
             }
         return _auto_obs_metrics
 
@@ -268,6 +277,10 @@ class _Replica:
         # recent per-request queue-wait observations, drained by
         # take_stats() into the controller's window for the p99 view
         self._queue_drain = _coll.deque(maxlen=256)
+        # replica-stamped time-to-first-token observations (handle
+        # dispatch -> first yielded chunk / unary completion), same
+        # drain -> window -> ttft_p99 path as the queue waits
+        self._ttft_drain = _coll.deque(maxlen=256)
 
     async def handle_request(self, method_name: str, args_blob: bytes):
         import contextvars as _cv
@@ -316,6 +329,8 @@ class _Replica:
                         None, functools.partial(ctx.run, fn, *args, **kwargs))
                     if asyncio.iscoroutine(out):
                         out = await out
+            # a unary response's first token IS the whole response
+            self._record_ttft(submit_ts)
             return out
         finally:
             obs = _obs()
@@ -362,9 +377,20 @@ class _Replica:
             self._arrived += 1
             if queue_wait is not None:
                 self._queue_drain.append(queue_wait)
+        stamped = False
+
+        def _stamp():
+            # first produced chunk stamps the server-side TTFT; later
+            # chunks are throughput, not first-token latency
+            nonlocal stamped
+            if not stamped:
+                stamped = True
+                self._record_ttft(submit_ts)
+
         try:
             if inspect.isasyncgenfunction(fn):
                 async for chunk in fn(*args, **kwargs):
+                    _stamp()
                     yield chunk
                 return
             out = fn(*args, **kwargs)
@@ -372,13 +398,16 @@ class _Replica:
                 out = await out
             if hasattr(out, "__aiter__"):
                 async for chunk in out:
+                    _stamp()
                     yield chunk
             elif hasattr(out, "__next__") or (
                     hasattr(out, "__iter__")
                     and not isinstance(out, (str, bytes, dict))):
                 for chunk in out:
+                    _stamp()
                     yield chunk
             else:
+                _stamp()
                 yield out
         finally:
             dt_exec = time.perf_counter() - t_exec
@@ -387,6 +416,19 @@ class _Replica:
                 self._completed += 1
                 self._execute_sum += dt_exec
                 self._execute_count += 1
+
+    def _record_ttft(self, submit_ts: Optional[float]):
+        """Stamp server-side time-to-first-token for one request (handle
+        dispatch wall clock -> now); rides the replica histogram and the
+        take_stats drain into the autoscaler's windowed ttft_p99."""
+        if submit_ts is None:
+            return
+        ttft = time.time() - submit_ts
+        if ttft < 0:
+            return  # clock skew between handle and replica hosts
+        _obs()["ttft"].observe(ttft)
+        with self._stats_lock:
+            self._ttft_drain.append(ttft)
 
     def num_ongoing(self) -> int:
         return self._num_ongoing
@@ -411,6 +453,8 @@ class _Replica:
             self._peak_ongoing = self._num_ongoing
             queue_samples = list(self._queue_drain)
             self._queue_drain.clear()
+            ttft_samples = list(self._ttft_drain)
+            self._ttft_drain.clear()
             return {
                 "arrived": self._arrived,
                 "completed": self._completed,
@@ -419,6 +463,7 @@ class _Replica:
                 "ongoing": self._num_ongoing,
                 "peak": peak,
                 "queue_samples": queue_samples,
+                "ttft_samples": ttft_samples,
             }
 
     def drain(self) -> int:
@@ -740,7 +785,8 @@ class _ServeController:
         slo = app.get("slo") or {}
         decision = decide(window, current_target=app["target"], config=auto,
                           state=state, now=now,
-                          queue_target_s=slo.get("queue_target_s"))
+                          queue_target_s=slo.get("queue_target_s"),
+                          ttft_target_s=slo.get("ttft_target_s"))
         rollup = window.rollup(now)
         self._publish_autoscale(name, app, rollup)
         if decision.want != app["target"]:
@@ -793,6 +839,9 @@ class _ServeController:
             qp99 = rollup.get("queue_p99_s")
             if qp99 is not None:
                 obs["queue_p99"].set(qp99, tags=tags)
+            tp99 = rollup.get("ttft_p99_s")
+            if tp99 is not None:
+                obs["ttft_p99"].set(tp99, tags=tags)
         except Exception:
             pass
         try:

@@ -15,10 +15,11 @@ demand; the policy then applies
 * **cooldown** — after any scale action the policy holds for
   ``scale_cooldown_s`` regardless of pressure, bounding actuation rate
   while replicas start/drain;
-* **queue-SLO pressure** — when the deployment registered a queue-wait
-  target and the windowed p99 exceeds it, the policy treats that as
-  up-pressure even if the rate math says capacity is sufficient (the
-  rate view can under-price demand while a backlog is already queued).
+* **SLO pressure** — when the deployment registered a queue-wait or
+  time-to-first-token target and the windowed p99 exceeds it, the policy
+  treats that as up-pressure even if the rate math says capacity is
+  sufficient (the rate view can under-price demand while a backlog is
+  already queued or streams are slow to first byte).
 
 Scale-up jumps straight to the demanded replica count (bursts need
 capacity NOW); scale-down steps one replica at a time so each release
@@ -81,19 +82,25 @@ def replica_demand(window: DeploymentMetricsWindow,
 
 def decide(window: DeploymentMetricsWindow, *, current_target: int,
            config, state: PolicyState, now: float,
-           queue_target_s: Optional[float] = None) -> Decision:
+           queue_target_s: Optional[float] = None,
+           ttft_target_s: Optional[float] = None) -> Decision:
     """One policy evaluation. ``config`` is the deployment's
     AutoscalingConfig (min/max bounds, target_ongoing_requests, delays,
-    hysteresis, cooldown); ``queue_target_s`` the registered queue-wait
-    SLO, if any."""
+    hysteresis, cooldown); ``queue_target_s`` / ``ttft_target_s`` the
+    registered queue-wait and time-to-first-token SLOs, if any."""
     demand, detail = replica_demand(window, config.target_ongoing_requests,
                                     now)
     detail["current_target"] = current_target
     queue_p99 = window.queue_p99_s(now)
     detail["queue_p99_s"] = None if queue_p99 is None else round(queue_p99, 6)
+    ttft_p99 = window.ttft_p99_s(now)
+    detail["ttft_p99_s"] = None if ttft_p99 is None else round(ttft_p99, 6)
 
-    slo_pressure = (queue_target_s is not None and queue_p99 is not None
-                    and queue_p99 > queue_target_s)
+    queue_pressure = (queue_target_s is not None and queue_p99 is not None
+                      and queue_p99 > queue_target_s)
+    ttft_pressure = (ttft_target_s is not None and ttft_p99 is not None
+                     and ttft_p99 > ttft_target_s)
+    slo_pressure = queue_pressure or ttft_pressure
     up_pressure = demand > current_target + 1e-9 or slo_pressure
     # hysteresis band: only shed a replica when demand fits the SMALLER
     # set with headroom to spare
@@ -113,11 +120,14 @@ def decide(window: DeploymentMetricsWindow, *, current_target: int,
                        max(current_target + 1, math.ceil(demand)))
             state.up_since = None
             state.last_scale_ts = now
-            why = ("queue p99 %.3fs over SLO %.3fs" % (queue_p99,
-                                                       queue_target_s)
-                   if slo_pressure and demand <= current_target
-                   else "demand %.2f replicas > target %d" % (demand,
-                                                              current_target))
+            if slo_pressure and demand <= current_target:
+                why = ("queue p99 %.3fs over SLO %.3fs"
+                       % (queue_p99, queue_target_s) if queue_pressure
+                       else "ttft p99 %.3fs over SLO %.3fs"
+                       % (ttft_p99, ttft_target_s))
+            else:
+                why = "demand %.2f replicas > target %d" % (demand,
+                                                            current_target)
             return Decision(want, why, "up", detail)
         return Decision(current_target, "up-pressure pending delay/cooldown",
                         "hold", detail)

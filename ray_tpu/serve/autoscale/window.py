@@ -57,6 +57,9 @@ class DeploymentMetricsWindow:
         # drained per-request queue-wait samples ride separately from the
         # tick ring so p99 comes from real observations, not tick means
         self._queue_samples: deque = deque(maxlen=max_queue_samples)
+        # server-side time-to-first-token observations (replica-stamped:
+        # handle dispatch -> first response chunk), same drain shape
+        self._ttft_samples: deque = deque(maxlen=max_queue_samples)
 
     # -- ingestion ------------------------------------------------------
 
@@ -78,6 +81,8 @@ class DeploymentMetricsWindow:
             sample["peak"] += st.get("peak", 0) or 0
             for q in st.get("queue_samples") or ():
                 self._queue_samples.append((now, float(q)))
+            for t in st.get("ttft_samples") or ():
+                self._ttft_samples.append((now, float(t)))
         self._points.append(sample)
         return sample
 
@@ -121,6 +126,14 @@ class DeploymentMetricsWindow:
         vals = sorted(v for ts, v in self._queue_samples if ts >= lo)
         return percentile(vals, 0.99)
 
+    def ttft_p99_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Windowed p99 of replica-stamped time-to-first-token (None
+        until a first token lands inside the window)."""
+        now = time.monotonic() if now is None else now
+        lo = now - self.window_s
+        vals = sorted(v for ts, v in self._ttft_samples if ts >= lo)
+        return percentile(vals, 0.99)
+
     def avg_ongoing(self, now: Optional[float] = None) -> float:
         """Mean concurrent-request level across window ticks — a rollup
         of the level series, not a point sample."""
@@ -144,6 +157,7 @@ class DeploymentMetricsWindow:
             "completion_rate": self.completion_rate(now),
             "execute_mean_s": self.execute_mean_s(now),
             "queue_p99_s": self.queue_p99_s(now),
+            "ttft_p99_s": self.ttft_p99_s(now),
             "avg_ongoing": self.avg_ongoing(now),
             "peak_ongoing": self.peak_ongoing(now),
             "samples": len(self._points),

@@ -154,8 +154,14 @@ def report(metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
     never mid-step."""
     import ray_tpu
     from ray_tpu.train.checkpoint import dir_to_tree
+    from ray_tpu.util import goodput
 
     ctx = get_context()
+    if "mfu" in metrics:
+        try:
+            goodput.note_mfu(float(metrics["mfu"]))
+        except (TypeError, ValueError):
+            pass
     ckpt_ref = None
     if checkpoint is not None and ctx.rank == 0:
         run_dir = getattr(ctx, "run_dir", None)

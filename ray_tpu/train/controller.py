@@ -80,7 +80,10 @@ class TrainController:
     def run(self) -> Dict[str, Any]:
         from ray_tpu.train.scaling_policy import make_scaling_policy, sized
         from ray_tpu.train.worker_group import WorkerGroup
+        from ray_tpu.util import goodput
 
+        goodput.set_job(self.run_dir.rsplit("/", 1)[-1])
+        reform_started: Optional[float] = None
         failures = 0
         max_failures = self.run_config.failure_config.max_failures
         last_error = None
@@ -118,6 +121,13 @@ class TrainController:
                         compression=getattr(scaling,
                                             "grad_sync_compression", None))
                 self.state = "RUNNING"
+                if reform_started is not None:
+                    # downtime window: first failure detection through the
+                    # re-formed group going back to RUNNING
+                    goodput.add("reform_downtime",
+                                time.monotonic() - reform_started)
+                    goodput.count("reforms")
+                    reform_started = None
                 refs = group.run(self.fn_blob, self.config, self._self_handle,
                                  self.manager.latest(), self.run_dir,
                                  self._shards_for(size))
@@ -157,6 +167,8 @@ class TrainController:
                 last_error = str(e)
                 failures += 1
                 self.state = "RESTARTING"
+                if reform_started is None:
+                    reform_started = time.monotonic()
                 if failures > max_failures:
                     latest = self.manager.latest()
                     self.state = "ERRORED"

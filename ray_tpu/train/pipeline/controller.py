@@ -228,15 +228,21 @@ class PipelineTrainer:
                 pass
 
     def _recover(self, err: Exception):
+        from ray_tpu.util import goodput
+
         self.recoveries += 1
         if self.recoveries > self.pipe.max_recoveries:
             raise RuntimeError(
                 f"pipeline gang failed {self.recoveries}x "
                 f"(max {self.pipe.max_recoveries}); last: {err}") from err
+        t0 = time.monotonic()
         self._kill_gang()
         self.generation += 1
         self._form_gang(restore=True)
         self.restored_steps.append(self.step)
+        goodput.set_job(self.run_name)
+        goodput.add("reform_downtime", time.monotonic() - t0)
+        goodput.count("reforms")
 
     # -- training ---------------------------------------------------------
 

@@ -355,6 +355,16 @@ class PipelineStage:
             # results and coordinating the clip across stages
             self._launch_reduce()
             reduce_launched = True
+        # goodput attribution from the per-op timers this schedule already
+        # keeps: recv waits are the pipeline bubble (idle until a neighbor
+        # produces), send waits block on channel backpressure
+        from ray_tpu.util import goodput
+
+        goodput.set_job(self.run_name)
+        goodput.add("step_compute", compute_s)
+        goodput.add("bubble", recv_s)
+        goodput.add("collective_wait", send_s)
+        goodput.count("steps")
         return {
             "stage": self.stage,
             "losses": losses,
@@ -389,11 +399,14 @@ class PipelineStage:
             return
         import jax
 
+        from ray_tpu.util import goodput
+
         flat, treedef = jax.tree_util.tree_flatten_with_path(self._acc)
         paths = [jax.tree_util.keystr(k) for k, _ in flat]
         reduced: Dict[str, np.ndarray] = {}
-        for handle in self._pending_reduce:
-            reduced.update(handle.result())
+        with goodput.region("collective_wait"):
+            for handle in self._pending_reduce:
+                reduced.update(handle.result())
         self._pending_reduce = None
         self._acc = jax.tree_util.tree_unflatten(
             treedef, [reduced[p] for p in paths])

@@ -110,7 +110,12 @@ class TrainWorker:
         # driver-authored blobs: decode only through the audited
         # serialization boundary (raylint SER001)
         from ray_tpu._private.serialization import loads_trusted
+        from ray_tpu.util import goodput
 
+        # tag this process's goodput ledger with the run so its bucket
+        # seconds aggregate under the right job GCS-side (a reused worker
+        # switching runs resets its accumulators in set_job)
+        goodput.set_job(run_dir.rsplit("/", 1)[-1])
         fn = loads_trusted(fn_blob)
         shards = loads_trusted(dataset_shard_blob) if dataset_shard_blob else {}
         ctx = TrainContext(

@@ -107,6 +107,21 @@ def cluster_health(scan: bool = False) -> Dict[str, Any]:
                      60.0)["health"]
 
 
+def goodput(job: Optional[str] = None) -> Dict[str, Any]:
+    """Per-job goodput ledgers from the GCS (``/api/goodput`` surface):
+    cumulative wall-clock attribution buckets (``step_compute``,
+    ``collective_wait``, ``input_stall``, ``ckpt_pause``, ``compile``,
+    ``reform_downtime``, ``bubble``, ``overhead``, ``idle``) summed over
+    the job's processes, plus counters (steps, compiles, RE-compiles,
+    ckpt saves, reforms) and the derived ``goodput_fraction``
+    (step_compute share of wall). ``job`` filters to one run name."""
+    core = _core()
+    req: Dict[str, Any] = {}
+    if job:
+        req["job"] = job
+    return core._run(core._gcs_call("GetGoodput", req), 30.0)["jobs"]
+
+
 def get_timeline(job_id: Optional[str] = None,
                  start_ts: Optional[float] = None,
                  end_ts: Optional[float] = None,

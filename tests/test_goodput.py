@@ -1,0 +1,453 @@
+"""Goodput ledger tests: exclusive/exhaustive region attribution (unit,
+incl. concurrent regions on racing threads), shape/dtype-keyed recompile
+detection, zygote fork-safety, the GCS-side per-job ledger + health
+findings (fixtures), and the acceptance e2e — one real CPU train job with
+an injected recompile, input stall and checkpoint save, attributed
+end-to-end through ``/api/goodput``, ``util.state.goodput()`` and
+``ray-tpu goodput``, with the recompile-storm and input-bound findings
+landing in ``/api/health``."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import goodput, state
+
+# fast cadences + aggressive finding thresholds so the cluster e2e sees
+# flushed ledgers and health findings within seconds (set before the
+# fixture spawns the GCS/workers — children inherit the env)
+_FAST_ENV = {
+    "RAY_TPU_METRICS_FLUSH_INTERVAL_S": "1.0",
+    "RAY_TPU_HEALTH_SCAN_INTERVAL_S": "1.0",
+    "RAY_TPU_GOODPUT_MIN_WALL_S": "1.0",
+    "RAY_TPU_GOODPUT_RECOMPILE_STORM_N": "2",
+    "RAY_TPU_GOODPUT_INPUT_BOUND_FRAC": "0.01",
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    goodput.reset()
+    yield
+    goodput.reset()
+
+
+def _wait_for(predicate, timeout=30, interval=0.5):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = predicate()
+        if out:
+            return out
+        time.sleep(interval)
+    return predicate()
+
+
+def _http_json(address, path):
+    with urllib.request.urlopen(f"http://{address}{path}", timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+# ---------------------------------------------------------------------------
+# region API: exclusive nesting, exhaustive decomposition (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_nested_regions_are_exclusive():
+    goodput.set_job("u-nest")
+    with goodput.region("step_compute"):
+        time.sleep(0.06)
+        with goodput.region("compile"):
+            time.sleep(0.08)
+        time.sleep(0.02)
+    snap = goodput.snapshot()
+    b = snap["buckets"]
+    # the child's 0.08 s belongs to compile ONLY — never double-billed
+    assert 0.05 <= b["step_compute"] <= 0.14
+    assert 0.07 <= b["compile"] <= 0.12
+    assert b["step_compute"] + b["compile"] <= snap["wall_s"] + 1e-6
+
+
+def test_snapshot_is_exhaustive_sum_to_wall():
+    goodput.set_job("u-sum")
+    with goodput.region("input_stall"):
+        time.sleep(0.03)
+    time.sleep(0.05)  # unattributed -> derived idle
+    snap = goodput.snapshot()
+    total = sum(snap["buckets"].values())  # includes derived idle
+    assert snap["buckets"]["idle"] >= 0.04
+    assert total == pytest.approx(snap["wall_s"], rel=0.02)
+    # every declared bucket is present even when zero
+    assert set(goodput.BUCKETS) < set(snap["buckets"])
+
+
+def test_concurrent_regions_on_racing_threads():
+    """Two threads attribute into different buckets at the same time:
+    the thread-local frame stacks never cross, each bucket gets its own
+    thread's seconds (per-thread exclusivity; across threads the sums
+    may legitimately exceed single wall-clock)."""
+    goodput.set_job("u-threads")
+    barrier = threading.Barrier(2)
+
+    def work(bucket):
+        barrier.wait()
+        for _ in range(5):
+            with goodput.region(bucket):
+                time.sleep(0.02)
+
+    threads = [threading.Thread(target=work, args=(b,))
+               for b in ("step_compute", "input_stall")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    b = goodput.snapshot()["buckets"]
+    assert 0.08 <= b["step_compute"] <= 0.30
+    assert 0.08 <= b["input_stall"] <= 0.30
+
+
+def test_set_job_change_resets_accumulators():
+    goodput.set_job("u-a")
+    goodput.add("ckpt_pause", 3.0)
+    goodput.count("ckpt_saves")
+    goodput.set_job("u-a")  # same job: accumulators survive
+    assert goodput.snapshot()["buckets"]["ckpt_pause"] == 3.0
+    goodput.set_job("u-b")  # new job: a reused worker leaks nothing
+    snap = goodput.snapshot()
+    assert snap["job"] == "u-b"
+    assert snap["buckets"]["ckpt_pause"] == 0.0
+    assert snap["counters"] == {}
+
+
+def test_flush_payload_none_for_idle_process():
+    # an untagged process that attributed nothing stays out of the
+    # goodput KV namespace entirely
+    assert goodput.flush_payload(node="n") is None
+    goodput.add("overhead", 0.01)
+    pay = goodput.flush_payload(node="n")
+    assert pay is not None and pay["node"] == "n" and pay["pid"] == os.getpid()
+
+
+# ---------------------------------------------------------------------------
+# compile watch: shape/dtype keying (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_watch_keying():
+    w = goodput.CompileWatch()
+    b1 = {"x": np.zeros((2, 4), np.float32), "y": np.zeros(2, np.int32)}
+    b1b = {"y": np.zeros(2, np.int32), "x": np.zeros((9, 9), np.float32)[:2, :4]}
+    b2 = {"x": np.zeros((2, 8), np.float32), "y": np.zeros(2, np.int32)}
+    b3 = {"x": np.zeros((2, 4), np.float64), "y": np.zeros(2, np.int32)}
+
+    assert w.observe("f", goodput.batch_key(b1)) == "compile"
+    # warm hit: same shapes/dtypes (key order independent) => nothing
+    assert w.observe("f", goodput.batch_key(b1b)) is None
+    # same fn + new shape => RECOMPILE, new dtype too
+    assert w.observe("f", goodput.batch_key(b2)) == "recompile"
+    assert w.observe("f", goodput.batch_key(b3)) == "recompile"
+    assert w.observe("f", goodput.batch_key(b2)) is None
+    # a different program starts its own key space
+    assert w.observe("g", goodput.batch_key(b2)) == "compile"
+
+
+# ---------------------------------------------------------------------------
+# fork safety: the zygote path drops inherited ledger state (unit)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(not hasattr(os, "fork"), reason="fork-less platform")
+def test_fork_resets_inherited_ledger():
+    goodput.set_job("fork-parent")
+    goodput.add("step_compute", 7.0)
+    goodput.count("steps", 3)
+
+    r, w = os.pipe()
+    pid = os.fork()
+    if pid == 0:  # child: the zygote fork path's reset, then introspect
+        code = 1
+        try:
+            os.close(r)
+            from ray_tpu._private.worker_main import (
+                reset_observability_after_fork)
+
+            reset_observability_after_fork()
+            snap = goodput.snapshot()
+            os.write(w, json.dumps({
+                "job": snap["job"],
+                "steps": snap["counters"].get("steps", 0),
+                "step_compute": snap["buckets"]["step_compute"],
+                "payload_none": goodput.flush_payload() is None,
+            }).encode())
+            code = 0
+        finally:
+            os._exit(code)
+    os.close(w)
+    try:
+        chunks = b""
+        while True:
+            chunk = os.read(r, 65536)
+            if not chunk:
+                break
+            chunks += chunk
+        _, status = os.waitpid(pid, 0)
+        assert os.waitstatus_to_exitcode(status) == 0
+        out = json.loads(chunks.decode())
+        # the child re-reports NOTHING of the parent's job: no
+        # double-counted seconds under a fresh proc key
+        assert out == {"job": "", "steps": 0, "step_compute": 0.0,
+                       "payload_none": True}
+        # the parent's ledger is untouched
+        assert goodput.snapshot()["buckets"]["step_compute"] == 7.0
+    finally:
+        os.close(r)
+
+
+# ---------------------------------------------------------------------------
+# GCS ledger: per-job aggregation + health findings (fixtures)
+# ---------------------------------------------------------------------------
+
+
+class _Cfg:
+    goodput_min_wall_s = 5.0
+    goodput_recompile_storm_n = 3
+    goodput_recompile_window_s = 300.0
+    goodput_input_bound_frac = 0.25
+    goodput_ckpt_budget_s = 5.0
+    goodput_regression_drop = 0.1
+    goodput_regression_min_points = 3
+
+
+def _pay(job, t, wall, buckets=None, counters=None, node="n1", mfu=None):
+    p = {"job": job, "pid": 1, "time": t, "started": t - wall,
+         "wall_s": wall, "node": node,
+         "buckets": dict(buckets or {}), "counters": dict(counters or {})}
+    if mfu is not None:
+        p["mfu"] = mfu
+    return p
+
+
+def _ledger():
+    from ray_tpu._private.gcs import GoodputLedger
+
+    return GoodputLedger()
+
+
+def test_ledger_aggregates_processes_per_job():
+    led = _ledger()
+    now = 1000.0
+    led.observe("proc_a", _pay("jobX", now, 100.0,
+                               {"step_compute": 60.0, "input_stall": 10.0},
+                               {"steps": 50}, node="nodeA", mfu=0.4))
+    led.observe("proc_b", _pay("jobX", now - 500, 100.0,  # stale proc
+                               {"step_compute": 20.0}, {"steps": 10},
+                               node="nodeB", mfu=0.3))
+    view = led.jobs(now)["jobX"]
+    assert view["wall_s"] == 200.0
+    assert view["buckets"]["step_compute"] == 80.0
+    assert view["counters"]["steps"] == 60
+    assert view["goodput_fraction"] == pytest.approx(0.4)
+    assert view["mfu"] == 0.4  # max across procs
+    assert view["procs"] == 2 and view["fresh_procs"] == 1
+    assert view["nodes"] == ["nodeA", "nodeB"]
+
+    # a re-tagged proc moves jobs: its old entry stops inflating jobX
+    led.observe("proc_a", _pay("jobY", now, 50.0, {"step_compute": 5.0}))
+    jobs = led.jobs(now)
+    assert jobs["jobX"]["wall_s"] == 100.0
+    assert jobs["jobY"]["procs"] == 1
+
+
+def test_ledger_findings_fixtures():
+    led = _ledger()
+    now = 2000.0
+    led.observe("p1", _pay("stormy", now, 100.0,
+                           {"step_compute": 50.0, "compile": 20.0},
+                           {"recompiles": 5, "compiles": 6}))
+    led.observe("p2", _pay("starved", now, 100.0,
+                           {"step_compute": 40.0, "input_stall": 30.0}))
+    led.observe("p3", _pay("pausey", now, 100.0,
+                           {"step_compute": 50.0, "ckpt_pause": 30.0},
+                           {"ckpt_saves": 3}))
+    led.observe("p4", _pay("short", now, 1.0,  # under min wall: exempt
+                           {"input_stall": 0.9}, {"recompiles": 9}))
+    led.observe("p5", _pay("stale", now - 500, 100.0,
+                           {"input_stall": 90.0}))
+
+    found = led.findings(now, _Cfg())
+    by_kind = {(f["kind"], f["job"]): f for f in found}
+    storm = by_kind[("recompile_storm", "stormy")]
+    assert storm["recompiles_in_window"] == 5 and storm["severity"] == "warning"
+    bound = by_kind[("input_bound", "starved")]
+    assert bound["input_stall_fraction"] == pytest.approx(0.3)
+    pause = by_kind[("ckpt_pause_over_budget", "pausey")]
+    assert pause["mean_pause_s"] == pytest.approx(10.0)
+    # the short job and the stale (finished) job never warn
+    assert not any(f["job"] in ("short", "stale") for f in found)
+
+    # storm windowing: with no NEW recompiles the trailing window drains
+    # and the storm finding stops re-firing
+    later = now + 10.0
+    led.observe("p1", _pay("stormy", later, 110.0,
+                           {"step_compute": 55.0, "compile": 20.0},
+                           {"recompiles": 5, "compiles": 6}))
+    again = led.findings(later, _Cfg())
+    assert not any(f["kind"] == "recompile_storm" for f in again)
+
+
+def test_ledger_goodput_regression_finding():
+    led = _ledger()
+    cfg = _Cfg()
+    now = 3000.0
+    # three healthy scans build the trailing window at fraction 0.8
+    for i in range(3):
+        led.observe("p1", _pay("reg", now + i, 100.0 + i,
+                               {"step_compute": 0.8 * (100.0 + i)}))
+        assert not any(f["kind"] == "goodput_regression"
+                       for f in led.findings(now + i, cfg))
+    # then the job collapses to 0.5: drop 0.3 > the 0.1 threshold
+    led.observe("p1", _pay("reg", now + 3, 200.0, {"step_compute": 100.0}))
+    found = [f for f in led.findings(now + 3, cfg)
+             if f["kind"] == "goodput_regression"]
+    assert found and found[0]["job"] == "reg"
+    assert found[0]["trailing_mean"] == pytest.approx(0.8)
+    assert found[0]["goodput_fraction"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# cluster e2e: injected recompile + input stall + ckpt pause, attributed
+# through every surface (the acceptance test)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def goodput_cluster():
+    ray_tpu.shutdown()
+    old = {k: os.environ.get(k) for k in _FAST_ENV}
+    os.environ.update(_FAST_ENV)
+    worker = ray_tpu.init(num_cpus=4, include_dashboard=True)
+    yield worker
+    ray_tpu.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+@ray_tpu.remote
+def _goodput_probe(ckpt_dir):
+    """One REAL CPU train job on a worker: four library train steps with
+    a batch seq-length change (=> jit recompiles through the compile
+    watch), a starved device-prefetch iterator (=> input_stall via the
+    real consumer loop), and a checkpoint save (=> ckpt_pause)."""
+    import jax
+
+    from ray_tpu import data
+    from ray_tpu.ckpt.saver import CheckpointSaver
+    from ray_tpu.ckpt.store import CheckpointStore
+    from ray_tpu.models import CONFIGS
+    from ray_tpu.parallel import TrainStepBundle, create_mesh
+    from ray_tpu.util import goodput as gp
+
+    gp.set_job("goodput-e2e")
+    mesh = create_mesh({"data": 1, "fsdp": 1, "seq": 1, "tensor": 1,
+                        "expert": 1}, devices=jax.devices()[:1])
+    bundle = TrainStepBundle(CONFIGS["tiny"], mesh)
+    params, opt_state = bundle.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    loss = None
+    for seq in (32, 32, 48, 64):  # 48/64 are NEW shape keys -> recompiles
+        batch = bundle.make_batch(rng, 2, seq)
+        params, opt_state, loss = bundle.step(params, opt_state, batch)
+
+    # injected input stall: a slow host pipeline starving the REAL
+    # iter_device_batches consumer loop
+    ds = data.from_items([{"x": 0}])
+
+    def slow_iter(batch_size=256, drop_last=False):
+        for _ in range(3):
+            time.sleep(0.4)
+            yield {"x": np.ones((2, 8), np.float32)}
+
+    ds.iter_batches = slow_iter
+    consumed = sum(1 for _ in ds.iter_device_batches(batch_size=2,
+                                                     device_prefetch=1))
+
+    saver = CheckpointSaver(CheckpointStore(ckpt_dir))
+    saver.save(jax.device_get(params), step=1, blocking=True)
+
+    time.sleep(2.5)  # hold past one observability flush (1 s cadence)
+    return {"snapshot": gp.snapshot(), "consumed": consumed,
+            "loss": float(loss)}
+
+
+def test_goodput_e2e_all_surfaces(goodput_cluster, tmp_path):
+    out = ray_tpu.get(_goodput_probe.remote(str(tmp_path / "ckpt")),
+                      timeout=600)
+    assert out["consumed"] == 3 and out["loss"] > 0
+    local = out["snapshot"]
+    assert local["counters"]["recompiles"] >= 2
+    assert local["counters"]["input_waits"] >= 3
+    assert local["counters"]["ckpt_saves"] == 1
+
+    # --- /api/goodput: the flushed ledger, attributed and exhaustive ---
+    address = goodput_cluster.node_supervisor.dashboard_address
+
+    def _job():
+        jobs = _http_json(address, "/api/goodput")
+        view = jobs.get("goodput-e2e")
+        if view and all(view["buckets"].get(b, 0) > 0
+                        for b in ("compile", "input_stall", "ckpt_pause")):
+            return view
+        return None
+
+    view = _wait_for(_job, timeout=60)
+    assert view, "goodput ledger never landed on /api/goodput"
+    assert view["buckets"]["step_compute"] > 0
+    assert view["counters"]["recompiles"] >= 2
+    # exhaustive: buckets (incl. derived idle) sum to wall within 2%
+    assert sum(view["buckets"].values()) == pytest.approx(
+        view["wall_s"], rel=0.02)
+    # the injected ~1.2 s stall is actually in the input bucket
+    assert view["buckets"]["input_stall"] >= 0.8
+
+    # ?job= filter
+    only = _http_json(address, "/api/goodput?job=goodput-e2e")
+    assert set(only) == {"goodput-e2e"}
+
+    # --- util.state surface ---
+    jobs = state.goodput()
+    assert jobs["goodput-e2e"]["buckets"]["ckpt_pause"] > 0
+    assert state.goodput(job="goodput-e2e")["goodput-e2e"]["wall_s"] > 0
+
+    # --- ray-tpu goodput CLI (a real subprocess driver) ---
+    gcs_address = goodput_cluster.node_supervisor.gcs_address
+    cli = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "--address",
+         gcs_address, "goodput"],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert cli.returncode == 0, cli.stderr[-2000:]
+    assert "goodput-e2e" in cli.stdout
+    for bucket in ("compile", "input_stall", "ckpt_pause"):
+        assert bucket in cli.stdout
+
+    # --- health findings: recompile storm + input-bound job ---
+    def _findings():
+        health = _http_json(address, "/api/health?scan=1")
+        kinds = {f["kind"] for f in health["findings"]
+                 if f.get("job") == "goodput-e2e"}
+        if {"recompile_storm", "input_bound"} <= kinds:
+            return health
+        return None
+
+    health = _wait_for(_findings, timeout=30)
+    assert health, "goodput findings never reached /api/health"

@@ -2141,3 +2141,57 @@ def test_don001_suppression(tmp_path):
             return new_params, norm
     """, relpath="ray_tpu/parallel/mod.py", root=tmp_path, rules=["DON001"])
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# OBS001 — goodput ledger + serve TTFT instruments (this PR's
+# ray_tpu.goodput.* / ray_tpu.serve.ttft_* series stay prefixed +
+# described; bucket names ride TAGS, never the metric name)
+# ---------------------------------------------------------------------------
+
+
+def test_obs001_goodput_metrics_positive():
+    findings = lint("""
+        from ray_tpu.util.metrics import Gauge, Histogram
+
+        frac = Gauge("goodput.fraction", "step_compute share of wall")
+        mfu = Gauge("ray_tpu.goodput.mfu")
+
+        def per_bucket(bucket):
+            return Gauge("ray_tpu.goodput." + bucket + "_seconds",
+                         "per-bucket seconds")
+    """, rules=["OBS001"])
+    assert rules_of(findings) == ["OBS001"] * 3
+    assert "ray_tpu_" in findings[0].message      # unprefixed gauge
+    assert "description" in findings[1].message   # undescribed MFU gauge
+    assert "static string" in findings[2].message  # per-bucket metric NAME
+
+
+def test_obs001_goodput_metrics_negative_shipped_shapes():
+    # the shapes this PR actually ships: every goodput/TTFT series is
+    # prefixed + described, the bucket axis is a tag on ONE gauge
+    findings = lint("""
+        from ray_tpu.util.metrics import Gauge, Histogram
+
+        frac = Gauge("ray_tpu.goodput.fraction",
+                     "step_compute share of ledger wall time for this "
+                     "process's active job")
+        mfu = Gauge("ray_tpu.goodput.mfu",
+                    "model FLOPs utilization last reported by the train "
+                    "loop on this process")
+        compiles = Gauge("ray_tpu.goodput.compiles",
+                         "cumulative jit compiles observed by the "
+                         "compile watch")
+        recompiles = Gauge("ray_tpu.goodput.recompiles",
+                           "cumulative shape/dtype-keyed jit RE-compiles "
+                           "(same program, new key)")
+        bucket_s = Gauge("ray_tpu.goodput.bucket_seconds",
+                         "cumulative attributed wall seconds per goodput "
+                         "bucket", tag_keys=("bucket",))
+        ttft = Histogram("ray_tpu.serve.ttft_seconds",
+                         "server-side time to first response chunk",
+                         boundaries=[0.01, 0.1, 1.0])
+        ttft_p99 = Gauge("ray_tpu.serve.ttft_p99_seconds",
+                         "windowed p99 of replica-stamped TTFT")
+    """, rules=["OBS001"])
+    assert findings == []

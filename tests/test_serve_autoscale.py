@@ -478,3 +478,87 @@ def test_bench_serve_smoke(cluster):
     assert result["scaled_up"] and result["scaled_down"]
     assert result["ttft_p99_ms"] > 0
     assert result["rolling_update_weights_version"] == 1
+
+
+# ---------------------------------------------------------------------------
+# server-side TTFT: window differentiation, policy pressure, SLO finding
+# ---------------------------------------------------------------------------
+
+
+def test_window_ttft_p99_from_replica_samples():
+    w = DeploymentMetricsWindow(window_s=10.0)
+    st = _stat(arrived=20, completed=20, execute_sum=4.0, execute_count=20)
+    st["ttft_samples"] = [0.05] * 18 + [0.9, 1.1]
+    w.observe([_stat()], now=100.0)
+    w.observe([st], now=102.0)
+    # p99 sees the slow-first-byte tail, not the happy median
+    assert w.ttft_p99_s(102.0) == pytest.approx(1.1)
+    assert w.rollup(102.0)["ttft_p99_s"] == pytest.approx(1.1)
+    # samples age out with the window
+    assert w.ttft_p99_s(102.0 + 11.0) is None
+
+
+def test_policy_ttft_slo_pressure():
+    """TTFT p99 over the registered target reads as up-pressure even when
+    the rate math says capacity is sufficient (streams slow to first
+    byte are invisible to Little's law)."""
+    auto = AutoscalingConfig(min_replicas=1, max_replicas=4,
+                             target_ongoing_requests=2.0,
+                             upscale_delay_s=0.0, scale_cooldown_s=0.0)
+    w = DeploymentMetricsWindow(window_s=10.0)
+    st = _stat(arrived=10, completed=10, execute_sum=0.5, execute_count=10)
+    st["ttft_samples"] = [2.0] * 8
+    w.observe([_stat()], now=10.0)
+    w.observe([st], now=11.0)
+    assert decide(w, current_target=1, config=auto, state=PolicyState(),
+                  now=11.0).direction == "hold"  # demand alone is tiny
+    d = decide(w, current_target=1, config=auto, state=PolicyState(),
+               now=11.0, ttft_target_s=0.5)
+    assert d.direction == "up"
+    assert "ttft" in d.reason and "SLO" in d.reason
+    assert d.metrics["ttft_p99_s"] == pytest.approx(2.0)
+
+
+def test_ttft_slo_violation_finding_e2e(cluster):
+    """Replica-stamped TTFT flows to the serve rollup, and a registered
+    `ttft_target_s` the deployment can't meet becomes a
+    `serve_slo_violation` finding on `ttft_p99_s` in the health scan."""
+    from ray_tpu.util.state import cluster_health, serve_state
+
+    @serve.deployment(name="slow_first_byte", max_ongoing_requests=4,
+                      autoscaling_config={"min_replicas": 1,
+                                          "max_replicas": 1,
+                                          "window_s": 30.0},
+                      ray_actor_options={"num_cpus": 0.25})
+    class SlowFirstByte:
+        async def __call__(self, body):
+            import asyncio
+
+            await asyncio.sleep(0.15)  # every first byte is late
+            return "late"
+
+    serve.run(SlowFirstByte.bind(), name="slow_first_byte")
+    ingress = serve.build_ingress(
+        "slow_first_byte", {"ttft_target_s": 0.01, "max_queue_depth": 64})
+    futs = [ingress.submit({}) for _ in range(10)]
+    assert all(f.result(timeout=120) == "late" for f in futs)
+
+    # the controller tick drains replica ttft samples into the window and
+    # mirrors rollup["ttft_p99_s"] into the serve KV namespace
+    deadline = time.monotonic() + 45.0
+    entry = None
+    while time.monotonic() < deadline:
+        entry = serve_state().get("slow_first_byte")
+        if entry and entry.get("rollup", {}).get("ttft_p99_s"):
+            break
+        time.sleep(0.5)
+    assert entry and entry["rollup"]["ttft_p99_s"] >= 0.1, entry
+    assert entry.get("slo", {}).get("ttft_target_s") == 0.01
+
+    findings = [f for f in cluster_health(scan=True)["findings"]
+                if f["kind"] == "serve_slo_violation"
+                and f.get("metric") == "ttft_p99_s"]
+    assert findings and findings[0]["deployment"] == "slow_first_byte"
+    assert findings[0]["value"] > findings[0]["target"]
+    ingress.close()
+    serve.delete("slow_first_byte")

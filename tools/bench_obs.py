@@ -9,7 +9,9 @@ rounds can hold the line on "observability is pay-for-what-you-use":
                                 record + batched AddTaskEvents flush
 * ``submit_us_*``             — end-to-end no-op task latency with
                                 observability fully off (baseline), task
-                                events on (default config), and tracing on
+                                events on (default config, goodput ledger
+                                included), events with only the goodput
+                                ledger off, and tracing on
 * ``*_delta_pct``             — overhead relative to the disabled baseline
 * ``train_step_us_*``         — one TrainStepBundle step (tiny config) with
                                 built-in spans on vs everything disabled
@@ -20,8 +22,10 @@ rounds can hold the line on "observability is pay-for-what-you-use":
 
 The acceptance bar rides ``traced_delta_pct`` (the microbench
 task-throughput path): end-to-end hot-path span overhead must stay <= 5%
-vs events-disabled. Emits one JSON object on stdout (plus --out FILE) so
-BENCH rounds can track regressions.
+vs events-disabled; ``goodput_delta_pct`` / ``train_step_goodput_delta_pct``
+hold the same bar for the default-on goodput ledger. Emits one JSON
+object on stdout (plus --out FILE) that ``tools/benchtrack.py --check``
+tracks for regressions.
 """
 
 from __future__ import annotations
@@ -106,18 +110,31 @@ def _bench_train_step(configs, steps: int = 12, warmup: int = 3):
                         "expert": 1}, devices=jax.devices()[:1])
     bundle = TrainStepBundle(CONFIGS["tiny"], mesh, donate=False)
     batch = bundle.make_batch(np.random.default_rng(0), 2, 64)
-    best = {}
+    # per-config live state; warm every config up front so compiles and
+    # first-touch costs never land inside a timed window
+    state = {}
     for name, apply in configs:
         apply()
         params, opt_state = bundle.init(jax.random.PRNGKey(0))
         for _ in range(warmup):
             params, opt_state, loss = bundle.step(params, opt_state, batch)
         jax.block_until_ready(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            params, opt_state, loss = bundle.step(params, opt_state, batch)
-        jax.block_until_ready(loss)
-        best[name] = (time.perf_counter() - t0) / steps * 1e6
+        state[name] = (params, opt_state)
+    # rounds INTERLEAVED across configs (like the submit bench) so CPU
+    # frequency/cache drift hits every config equally; per-config minimum
+    best = {name: float("inf") for name, _ in configs}
+    for _ in range(4):
+        for name, apply in configs:
+            apply()
+            params, opt_state = state[name]
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                params, opt_state, loss = bundle.step(params, opt_state,
+                                                      batch)
+            jax.block_until_ready(loss)
+            best[name] = min(best[name],
+                             (time.perf_counter() - t0) / steps * 1e6)
+            state[name] = (params, opt_state)
     return best
 
 
@@ -134,7 +151,7 @@ def _bench_serve_request(ray_tpu, configs, n: int = 100):
     handle = serve.run(_Echo.bind(), name="bench_obs_echo")
     ray_tpu.get([handle.remote(i) for i in range(20)], timeout=120)  # warm
     best = {name: float("inf") for name, _ in configs}
-    for _ in range(3):
+    for _ in range(5):
         for name, apply in configs:
             apply()
             t0 = time.perf_counter()
@@ -189,30 +206,50 @@ def main(argv=None):
     ray_tpu.init(num_cpus=4)
     out = {}
 
+    def _goodput(on: bool):
+        # config env is read per-access, so this flips the ledger hooks
+        # (region timers, compile watch, flush payload) live in-process
+        os.environ["RAY_TPU_GOODPUT_ENABLED"] = "1" if on else "0"
+
     def _off():
         task_events.set_enabled(False)
         tracing._enabled = False
+        _goodput(False)
 
     def _events():
+        # the DEFAULT production config: task events + goodput ledger on
         task_events.set_enabled(True)
         tracing._enabled = False
+        _goodput(True)
+
+    def _events_nogoodput():
+        task_events.set_enabled(True)
+        tracing._enabled = False
+        _goodput(False)
 
     def _traced():
         task_events.set_enabled(True)
         tracing._enabled = True
+        _goodput(True)
 
     best = _bench_submission_configs(
         ray_tpu,
-        [("disabled", _off), ("events", _events), ("traced", _traced)],
+        [("disabled", _off), ("events", _events),
+         ("events_nogoodput", _events_nogoodput), ("traced", _traced)],
         args.rounds, args.tasks)
     out["submit_us_disabled"] = best["disabled"]
     out["submit_us_events"] = best["events"]
+    out["submit_us_events_nogoodput"] = best["events_nogoodput"]
     out["submit_us_traced"] = best["traced"]
 
     out["events_delta_pct"] = 100.0 * (
         out["submit_us_events"] / out["submit_us_disabled"] - 1.0)
     out["traced_delta_pct"] = 100.0 * (
         out["submit_us_traced"] / out["submit_us_disabled"] - 1.0)
+    # goodput-ledger cost on the no-op task path: default config (ledger
+    # on) vs the same config with only the ledger off
+    out["goodput_delta_pct"] = 100.0 * (
+        out["submit_us_events"] / out["submit_us_events_nogoodput"] - 1.0)
 
     out["span_record_per_s"] = _bench_span_record()
     out["event_record_us"] = _bench_event_record()
@@ -226,6 +263,7 @@ def main(argv=None):
     #   traced   — full span COLLECTION on (diagnostic mode: every span
     #              recorded + shipped to the GCS trace table)
     hot_configs = [("disabled", _off), ("events", _events),
+                   ("events_nogoodput", _events_nogoodput),
                    ("traced", _traced)]
     try:
         train = _bench_train_step(hot_configs)
@@ -235,6 +273,10 @@ def main(argv=None):
             train["events"] / train["disabled"] - 1.0)
         out["train_step_traced_delta_pct"] = 100.0 * (
             train["traced"] / train["disabled"] - 1.0)
+        # goodput-ledger cost on the warm train step (region timers + a
+        # compile-watch key per step; ledger on vs only the ledger off)
+        out["train_step_goodput_delta_pct"] = 100.0 * (
+            train["events"] / train["events_nogoodput"] - 1.0)
     except Exception as e:  # no jax/flax in this env: skip, don't sink
         out["train_step_error"] = f"{type(e).__name__}: {e}"
     serve_lat = _bench_serve_request(ray_tpu, hot_configs)
@@ -255,6 +297,7 @@ def main(argv=None):
 
     tracing._enabled = None
     task_events.set_enabled(None)
+    os.environ.pop("RAY_TPU_GOODPUT_ENABLED", None)
     ray_tpu.shutdown()
 
     print(json.dumps(out, indent=2))

@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Bench trajectory tracker: one schema over the repo's bench artifacts
+plus a regression gate.
+
+The repo accumulates per-round JSON artifacts with five different shapes
+(``BENCH_rNN.json`` nests under ``parsed``, ``PIPE_rNN.json`` is a list
+of name/value entries, ``STRESS``/``SERVE``/``OBS`` are flat dicts).
+This tool normalizes them into one trajectory —
+``family -> [(round, {metric: value}), ...]`` — and flags metric
+regressions beyond per-metric relative thresholds (MFU, tasks/s, TTFT
+p99, bubble/overlap fractions, observability overhead), closing the
+ROADMAP residual "overlap_fraction regression tracking across BENCH
+rounds".
+
+Modes:
+  python tools/benchtrack.py            # print the trajectory
+  python tools/benchtrack.py --check    # regression gate (exit 1 on fail)
+  python tools/benchtrack.py --json     # machine-readable trajectory
+
+``--check`` compares each family's latest round against its previous
+round per metric (direction-aware: higher-better throughput vs
+lower-better latency), plus ABSOLUTE bars for the observability
+overhead percentages (the OBS_r01 "always-on instrumentation stays
+under 5% of the hot path" contract). Wired into tier-1 as a smoke test
+(tests/test_benchtrack.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# observability overhead bar (percent): always-on hooks must stay under
+# this on the hot paths, whatever the previous round measured
+OBS_OVERHEAD_BAR_PCT = 5.0
+
+
+@dataclass
+class MetricSpec:
+    """How one metric regresses: ``direction`` is which way is GOOD
+    ("higher" throughput/fractions vs "lower" latency/overhead);
+    ``rel_threshold`` the tolerated relative move in the bad direction
+    vs the previous round; ``bar`` an optional absolute ceiling that
+    applies regardless of history (lower-better metrics only)."""
+
+    direction: str
+    rel_threshold: float = 0.10
+    bar: Optional[float] = None
+
+
+# explicit specs for the flat-dict families; PIPE metric names are
+# priced by suffix rules below (the stage count in the name varies)
+METRIC_SPECS: Dict[str, MetricSpec] = {
+    # BENCH (train MFU)
+    "train_mfu_1b": MetricSpec("higher", 0.05),
+    "mfu_350m": MetricSpec("higher", 0.05),
+    "tokens_per_sec_per_chip": MetricSpec("higher", 0.05),
+    "step_time_s": MetricSpec("lower", 0.10),
+    # STRESS (control-plane throughput)
+    "tasks_per_s": MetricSpec("higher", 0.15),
+    "aggregate_tasks_per_s": MetricSpec("higher", 0.15),
+    "actor_creates_per_s": MetricSpec("higher", 0.20),
+    "lease_grant_p50_ms": MetricSpec("lower", 0.50),
+    "lease_grant_p95_ms": MetricSpec("lower", 0.50),
+    "submit_fast_path_frac": MetricSpec("higher", 0.05),
+    # SERVE (latency + loss)
+    "ttft_p50_ms": MetricSpec("lower", 0.25),
+    "ttft_p99_ms": MetricSpec("lower", 0.25),
+    "latency_p99_ms": MetricSpec("lower", 0.25),
+    "tokens_per_s": MetricSpec("higher", 0.15),
+    "dropped_requests": MetricSpec("lower", 0.0, bar=0.0),
+    # OBS (always-on instrumentation overhead, percent): gated by the
+    # absolute <=5% bar, generously thresholded round-over-round (these
+    # are microbenchmarks with real scheduling noise)
+    "events_delta_pct": MetricSpec("lower", 3.0, bar=OBS_OVERHEAD_BAR_PCT),
+    "train_step_delta_pct": MetricSpec("lower", 3.0,
+                                       bar=OBS_OVERHEAD_BAR_PCT),
+    "serve_request_delta_pct": MetricSpec("lower", 3.0,
+                                          bar=OBS_OVERHEAD_BAR_PCT),
+    "hot_path_span_overhead_pct": MetricSpec("lower", 3.0,
+                                             bar=OBS_OVERHEAD_BAR_PCT),
+    "goodput_delta_pct": MetricSpec("lower", 3.0,
+                                    bar=OBS_OVERHEAD_BAR_PCT),
+    "train_step_goodput_delta_pct": MetricSpec("lower", 3.0,
+                                               bar=OBS_OVERHEAD_BAR_PCT),
+}
+
+# suffix -> spec rules for PIPE-style generated metric names
+SUFFIX_SPECS: List[Tuple[str, MetricSpec]] = [
+    ("_tokens_per_s", MetricSpec("higher", 0.15)),
+    ("tokens_per_s", MetricSpec("higher", 0.15)),
+    ("_vs_single_mesh", MetricSpec("higher", 0.15)),
+    ("_bubble_fraction", MetricSpec("lower", 0.25)),
+    ("_idle_fraction_measured", MetricSpec("lower", 0.25)),
+    ("_overlap_fraction", MetricSpec("higher", 0.10)),
+]
+
+
+def spec_for(metric: str) -> Optional[MetricSpec]:
+    spec = METRIC_SPECS.get(metric)
+    if spec is not None:
+        return spec
+    for suffix, s in SUFFIX_SPECS:
+        if metric.endswith(suffix):
+            return s
+    return None
+
+
+# -- per-family extraction (each returns {metric: float}) ----------------
+
+
+def _numeric(d: dict, keys) -> Dict[str, float]:
+    out = {}
+    for k in keys:
+        v = d.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+    return out
+
+
+def _extract_bench(payload) -> Dict[str, float]:
+    parsed = payload.get("parsed") or {}
+    out = {}
+    metric = parsed.get("metric")
+    if metric and isinstance(parsed.get("value"), (int, float)):
+        out[str(metric)] = float(parsed["value"])
+    out.update(_numeric(parsed, ("mfu_350m", "tokens_per_sec_per_chip",
+                                 "step_time_s", "overlap_fraction",
+                                 "mfu_1chip")))
+    return out
+
+
+def _extract_flat(payload) -> Dict[str, float]:
+    if not isinstance(payload, dict):
+        return {}
+    return {k: float(v) for k, v in payload.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+            and spec_for(k) is not None}
+
+
+def _extract_pipe(payload) -> Dict[str, float]:
+    if not isinstance(payload, list):
+        return {}
+    out = {}
+    for entry in payload:
+        if not isinstance(entry, dict):
+            continue
+        name, value = entry.get("name"), entry.get("value")
+        if (isinstance(name, str) and isinstance(value, (int, float))
+                and not isinstance(value, bool)
+                and spec_for(name) is not None):
+            out[name] = float(value)
+    return out
+
+
+FAMILIES = {
+    "BENCH": _extract_bench,
+    "STRESS": _extract_flat,
+    "SERVE": _extract_flat,
+    "PIPE": _extract_pipe,
+    "OBS": _extract_flat,
+}
+
+_ROUND_RE = re.compile(r"^([A-Z_]+?)_r(\d+)\.json$")
+
+
+def load_trajectory(root: str = REPO_ROOT) -> Dict[str, List[dict]]:
+    """All recognized artifacts normalized into one trajectory:
+    ``{family: [{"round": n, "file": name, "metrics": {...}}, ...]}``,
+    rounds ascending. Unreadable/foreign files are skipped (the repo
+    root also holds non-bench JSON)."""
+    out: Dict[str, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(root, "*.json"))):
+        m = _ROUND_RE.match(os.path.basename(path))
+        if not m or m.group(1) not in FAMILIES:
+            continue
+        family, rnd = m.group(1), int(m.group(2))
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        metrics = FAMILIES[family](payload)
+        if not metrics:
+            continue
+        out.setdefault(family, []).append(
+            {"round": rnd, "file": os.path.basename(path),
+             "metrics": metrics})
+    for rounds in out.values():
+        rounds.sort(key=lambda r: r["round"])
+    return out
+
+
+def check(root: str = REPO_ROOT) -> Tuple[List[str], List[str]]:
+    """The regression gate. Returns ``(failures, passes)`` as printable
+    lines; empty ``failures`` means the gate is green. Latest round vs
+    previous round per family/metric (direction-aware relative
+    threshold), plus the absolute bars on every round's latest."""
+    trajectory = load_trajectory(root)
+    failures: List[str] = []
+    passes: List[str] = []
+    for family, rounds in sorted(trajectory.items()):
+        latest = rounds[-1]
+        prev = rounds[-2] if len(rounds) > 1 else None
+        for metric, value in sorted(latest["metrics"].items()):
+            spec = spec_for(metric)
+            if spec is None:
+                continue
+            where = f"{family} {latest['file']} {metric}"
+            if spec.bar is not None and value > spec.bar:
+                failures.append(
+                    f"{where}: {value:g} over the absolute bar "
+                    f"{spec.bar:g}")
+                continue
+            base = (prev or {}).get("metrics", {}).get(metric) \
+                if prev else None
+            if base is None:
+                passes.append(f"{where}: {value:g} (no prior round)")
+                continue
+            if spec.direction == "higher":
+                floor = base * (1.0 - spec.rel_threshold)
+                # a negative-baseline metric can't price a relative
+                # floor meaningfully; treat any value as holding
+                if base > 0 and value < floor:
+                    failures.append(
+                        f"{where}: {value:g} < {floor:g} "
+                        f"(prev {base:g}, -{spec.rel_threshold:.0%} "
+                        f"threshold)")
+                    continue
+            else:
+                ceil = base + abs(base) * spec.rel_threshold \
+                    if base != 0 else spec.rel_threshold
+                if value > ceil and (spec.bar is None or value > 0):
+                    failures.append(
+                        f"{where}: {value:g} > {ceil:g} "
+                        f"(prev {base:g}, +{spec.rel_threshold:.0%} "
+                        f"threshold)")
+                    continue
+            passes.append(f"{where}: {value:g} (prev {base:g})")
+    return failures, passes
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="bench artifact trajectory + regression gate")
+    parser.add_argument("--dir", default=REPO_ROOT,
+                        help="artifact directory (default: repo root)")
+    parser.add_argument("--check", action="store_true",
+                        help="regression gate: exit 1 on any regression")
+    parser.add_argument("--json", action="store_true",
+                        help="print the normalized trajectory as JSON")
+    args = parser.parse_args(argv)
+
+    if args.check:
+        failures, passes = check(args.dir)
+        for line in passes:
+            print(f"  ok   {line}")
+        for line in failures:
+            print(f"  FAIL {line}")
+        print(f"benchtrack: {len(passes)} ok, {len(failures)} regressed")
+        return 1 if failures else 0
+
+    trajectory = load_trajectory(args.dir)
+    if args.json:
+        print(json.dumps(trajectory, indent=2, sort_keys=True))
+        return 0
+    for family, rounds in sorted(trajectory.items()):
+        print(f"{family}: rounds "
+              f"{', '.join(str(r['round']) for r in rounds)}")
+        latest = rounds[-1]
+        for metric, value in sorted(latest["metrics"].items()):
+            series = [r["metrics"].get(metric) for r in rounds]
+            path = " -> ".join("?" if v is None else f"{v:g}"
+                               for v in series)
+            print(f"  {metric:40} {path}")
+    if not trajectory:
+        print("no bench artifacts found")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
