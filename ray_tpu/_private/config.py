@@ -200,6 +200,23 @@ _d("goodput_ckpt_budget_s", 5.0)       # mean ckpt pause per save budget
 _d("goodput_regression_drop", 0.1)
 _d("goodput_regression_min_points", 6)
 
+# --- checkpoint storage tier (ckpt/tier) ---
+_d("ckpt_io_threads", 8)  # per-host parallel chunk transfer workers
+# per-host in-flight payload byte cap for cross-tier chunk transfers
+_d("ckpt_io_inflight_bytes", 256 * 1024**2)
+# ranged reads separated by at most this many bytes coalesce into one GET
+_d("ckpt_io_coalesce_gap", 64 * 1024)
+_d("ckpt_mirror_enabled", True)  # TieredStore commits enqueue a mirror
+_d("ckpt_multipart_bytes", 8 * 1024**2)  # bucket uploads split above this
+# GCS-side retention sweeper cadence over opted-in stores (0 disables)
+_d("ckpt_sweep_interval_s", 30.0)
+# chunks younger than this are never reaped on any tier (in-flight saves
+# and mirrors write chunks before the manifest that names them)
+_d("ckpt_sweep_grace_s", 300.0)
+# when set, train-run checkpoint stores become TieredStores mirroring to
+# a bucket rooted here (one prefix per run); "" keeps them local-only
+_d("ckpt_tier_root", "")
+
 # --- train / libs ---
 _d("train_health_check_period_s", 1.0)
 _d("serve_proxy_port", 8000)

@@ -1082,6 +1082,8 @@ class GcsServer:
                                       what="gcs resource-view flusher"))
         self._background.append(spawn(self._health_monitor_loop(),
                                       what="gcs health-monitor scanner"))
+        self._background.append(spawn(self._ckpt_sweep_loop(),
+                                      what="gcs ckpt retention sweeper"))
         # resume interrupted scheduling work from replayed init data
         for record in self.actors.values():
             if record.state in ("PENDING_CREATION", "RESTARTING"):
@@ -2274,6 +2276,66 @@ class GcsServer:
         return {"history": self.metrics_history.series(
             name, window_s=req.get("window_s"),
             tier=req.get("tier") or "auto")}
+
+    async def _ckpt_sweep_loop(self):
+        """Cluster-side checkpoint retention (reference analog: the GCS
+        owning GC instead of each driver): periodically sweep every
+        checkpoint store whose KV stats mirror carries a ``sweep``
+        policy. The filesystem/backend work runs off-loop in the default
+        executor — a slow tier must not stall the control plane."""
+        interval = RAY_CONFIG.ckpt_sweep_interval_s
+        if not interval:
+            return
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self._ckpt_sweep()
+            except Exception:
+                logger.exception("ckpt retention sweep failed")
+
+    async def _ckpt_sweep(self) -> list:
+        """One cluster-wide retention pass over opted-in stores. Reports
+        land in KV ns="ckpt_sweep" (state API / dashboard) and reap
+        activity becomes ``ckpt_sweeper`` events."""
+        entries = {}
+        for (ns, key), blob in list(self.kv.items()):
+            if ns != "ckpt":
+                continue
+            try:
+                entries[key] = wire.loads(blob)
+            except Exception:
+                logger.debug("ckpt sweep: undecodable stats mirror for "
+                             "store %r; skipping", key)
+                continue
+        if not entries:
+            return []
+        from ray_tpu.ckpt.tier.sweeper import sweep_registered
+
+        loop = asyncio.get_running_loop()
+        reports = await loop.run_in_executor(None, sweep_registered, entries)
+        for rep in reports:
+            name = str(rep.get("name") or rep.get("root") or "?")
+            blob = wire.dumps(rep)
+            self.kv[("ckpt_sweep", name)] = blob
+            self._persist_kv("ckpt_sweep", name, blob)
+            if rep.get("error"):
+                self._record_event(
+                    "ckpt_sweeper", "WARNING",
+                    f"retention sweep of store {name} failed: "
+                    f"{rep['error']}", root=rep.get("root"))
+            elif rep.get("dropped_manifests") or rep.get("dropped_bytes"):
+                self._record_event(
+                    "ckpt_sweeper", "INFO",
+                    f"store {name}: reaped {rep['dropped_manifests']} "
+                    f"manifests / {rep['dropped_bytes']} chunk bytes "
+                    f"across tiers",
+                    root=rep.get("root"), local=rep.get("local"),
+                    remote=rep.get("remote"))
+        return reports
+
+    async def _rpc_CkptSweep(self, req, conn):
+        """Force a cluster retention sweep now (tests, ``ray-tpu ckpt``)."""
+        return {"reports": await self._ckpt_sweep()}
 
     async def _health_monitor_loop(self):
         interval = RAY_CONFIG.health_scan_interval_s

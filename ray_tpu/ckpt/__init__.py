@@ -37,6 +37,14 @@ _EXPORTS = {
     "restore_tree": "restore", "restore_shards": "restore",
     "restore_plan": "restore", "restore_spec": "restore",
     "restore_tree_shards": "restore",
+    # storage tier plane (PR 19)
+    "TieredStore": "tier.tiered", "attach": "tier.tiered",
+    "ChunkBackend": "tier.backend", "LocalFSBackend": "tier.backend",
+    "BucketBackend": "tier.bucket", "DirBucketClient": "tier.bucket",
+    "FaultShim": "tier.bucket",
+    "ObjectPlaneBackend": "tier.object_plane",
+    "ParallelIO": "tier.pario",
+    "SweepPolicy": "tier.sweeper", "sweep_store": "tier.sweeper",
 }
 
 
@@ -71,4 +79,14 @@ __all__ = [
     "restore_tree_shards",
     "diff_manifests",
     "new_ckpt_id",
+    "TieredStore",
+    "ChunkBackend",
+    "LocalFSBackend",
+    "BucketBackend",
+    "DirBucketClient",
+    "FaultShim",
+    "ObjectPlaneBackend",
+    "ParallelIO",
+    "SweepPolicy",
+    "sweep_store",
 ]

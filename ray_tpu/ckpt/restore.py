@@ -52,14 +52,29 @@ def restore_plan(manifest: mf.Manifest, dst_spec):
     return plan_reshard(src, dst_spec)
 
 
+def _read_chunks(store: CheckpointStore,
+                 sizes: Dict[str, int]) -> Dict[str, bytes]:
+    """Batch chunk read through the store's tier plane when it has one:
+    a ``TieredStore`` serves local bytes and pulls evicted chunks from
+    the remote tier in parallel (sha256-verified, cached locally); a
+    plain store reads the local pool serially as before."""
+    fetch = getattr(store, "fetch_chunks", None)
+    if fetch is not None:
+        return fetch(sizes)
+    return {h: mf.read_chunk(store.root, h) for h in sizes}
+
+
 def _py_leaves(store: CheckpointStore, manifest: mf.Manifest) -> Dict[str, Any]:
     from ray_tpu._private.serialization import loads_oob
 
+    sizes = {entry.chunks[""][0]: entry.chunks[""][1]
+             for entry in manifest.leaves.values() if entry.kind == mf.PY}
+    blobs = _read_chunks(store, sizes)
     out = {}
     for path, entry in manifest.leaves.items():
         if entry.kind == mf.PY:
             h, _ = entry.chunks[""]
-            out[path] = loads_oob(mf.read_chunk(store.root, h))
+            out[path] = loads_oob(blobs[h])
     return out
 
 
@@ -81,6 +96,9 @@ def restore_tree(store: CheckpointStore, ckpt_id: Optional[str] = None,
     else:
         manifest = store.wait_for(ckpt_id, timeout=timeout)
     leaves: Dict[str, Any] = _py_leaves(store, manifest)
+    sizes = {h: nb for entry in manifest.leaves.values()
+             if entry.kind == mf.ND for h, nb in entry.chunks.values()}
+    blobs = _read_chunks(store, sizes)
     for path, entry in manifest.leaves.items():
         if entry.kind != mf.ND:
             continue
@@ -88,7 +106,7 @@ def restore_tree(store: CheckpointStore, ckpt_id: Optional[str] = None,
         out = np.empty(entry.shape, dtype=dt)
         for box_s, (h, _nb) in entry.chunks.items():
             box = mf.decode_box(box_s) or tuple((0, s) for s in entry.shape)
-            data = np.frombuffer(mf.read_chunk(store.root, h), dtype=dt)
+            data = np.frombuffer(blobs[h], dtype=dt)
             out[box_slices(box)] = data.reshape(
                 tuple(b - a for a, b in box))
         leaves[path] = out
@@ -117,35 +135,46 @@ def restore_shards(store: CheckpointStore, dst_spec, host: str,
     else:
         manifest = store.wait_for(ckpt_id, timeout=timeout)
     plan = restore_plan(manifest, dst_spec)
-    out: Dict[str, Dict[Any, Any]] = {}
-    bytes_read = 0
-    chunks_read = 0
-    cache: Dict[str, np.ndarray] = {}
+    # pass 1: every (leaf, dst box) names the chunks it intersects — the
+    # union is exactly the bytes this host owns under the plan, fetched
+    # once each (and, on a TieredStore, concurrently across tiers)
+    needed: Dict[str, int] = {}
+    per_leaf: Dict[str, list] = {}
     for leaf, (shape, dtype) in dst_spec.meta.items():
         entry = manifest.leaves.get(leaf)
         if entry is None or entry.kind != mf.ND:
             raise KeyError(f"checkpoint {manifest.ckpt_id!r} has no array "
                            f"leaf {leaf!r}")
-        dt = np.dtype(dtype)
         chunk_boxes = [
             (mf.decode_box(bs) or tuple((0, s) for s in entry.shape), h, nb)
             for bs, (h, nb) in entry.chunks.items()]
+        per_leaf[leaf] = chunk_boxes
+        for dbox in host_boxes(dst_spec.mesh, dst_spec.part_of(leaf),
+                               shape, host):
+            for cbox, h, nb in chunk_boxes:
+                if intersect_box(dbox, cbox) is not None:
+                    needed[h] = nb
+    blobs = _read_chunks(store, needed)
+    bytes_read = sum(len(b) for b in blobs.values())
+    chunks_read = len(blobs)
+    # pass 2: assemble this host's shards from the fetched chunk bytes
+    out: Dict[str, Dict[Any, Any]] = {}
+    cache: Dict[str, np.ndarray] = {}
+    for leaf, (shape, dtype) in dst_spec.meta.items():
+        dt = np.dtype(dtype)
         out[leaf] = {}
         for dbox in host_boxes(dst_spec.mesh, dst_spec.part_of(leaf),
                                shape, host):
             shard = np.empty(tuple(b - a for a, b in dbox), dtype=dt)
-            for cbox, h, _nb in chunk_boxes:
+            for cbox, h, _nb in per_leaf[leaf]:
                 inter = intersect_box(dbox, cbox)
                 if inter is None:
                     continue
                 chunk = cache.get(h)
                 if chunk is None:
-                    chunk = np.frombuffer(
-                        mf.read_chunk(store.root, h), dtype=dt).reshape(
+                    chunk = np.frombuffer(blobs[h], dtype=dt).reshape(
                         tuple(b - a for a, b in cbox))
                     cache[h] = chunk
-                    bytes_read += chunk.nbytes
-                    chunks_read += 1
                 shard[rel_slices(inter, dbox)] = chunk[rel_slices(inter, cbox)]
             out[leaf][dbox] = shard
     stats = {"ckpt_id": manifest.ckpt_id, "bytes_read": bytes_read,

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -42,6 +43,9 @@ class CheckpointStore:
         # survive the process: truncation evidence must not vanish)
         self._counters = self._load_counters()
         self._last_mirror = 0.0
+        # commit (caller thread) and a TieredStore's mirror pump both
+        # throttle through _last_mirror
+        self._mirror_lock = threading.Lock()
 
     # -- lookup --------------------------------------------------------
 
@@ -137,7 +141,12 @@ class CheckpointStore:
         if keep_last is None:
             keep.update(ids)
         elif keep_last > 0:
-            keep.update(ids[-keep_last:])
+            # keep-last counts checkpoints NOT already kept by pin/keep_ids:
+            # pinned auxiliary manifests (e.g. the weight plane's durable
+            # ``weights-*`` versions, which sort after ``step*`` ids) must
+            # not consume keep-last slots and evict the newest training
+            # checkpoint
+            keep.update([cid for cid in ids if cid not in keep][-keep_last:])
         drop = [cid for cid in ids if cid not in keep]
         dropped_chunks = dropped_bytes = 0
         live: Dict[str, int] = {}
@@ -147,6 +156,13 @@ class CheckpointStore:
                     live.update(self.read(cid).chunk_set())
                 except (FileNotFoundError, json.JSONDecodeError, KeyError):
                     continue
+        # chunks referenced by in-flight sharded saves (un-committed
+        # part-files) are live regardless of age: a slow peer host's
+        # already-written chunks must survive a racing retention pass
+        # even past the grace window
+        from ray_tpu.ckpt.tier.sweeper import _inflight_chunks
+
+        live.update(_inflight_chunks(self.root))
         for cid in drop:
             try:
                 os.remove(mf.manifest_path(self.root, cid))
@@ -226,9 +242,11 @@ class CheckpointStore:
         work with no cluster at all (unit tests, offline tools).
         ``min_interval`` rate-limits the (whole-store) stats walk on hot
         paths; explicit mutations (pin/retention) mirror unconditionally."""
-        if min_interval and time.time() - self._last_mirror < min_interval:
-            return
-        self._last_mirror = time.time()
+        with self._mirror_lock:
+            if min_interval and (time.time() - self._last_mirror
+                                 < min_interval):
+                return
+            self._last_mirror = time.time()
         try:
             from ray_tpu._private.worker import is_initialized
 

@@ -1,0 +1,54 @@
+"""ray_tpu.ckpt.tier: the pluggable checkpoint storage plane.
+
+Layers (see ``ray_tpu/ckpt/README.md`` for the full design):
+
+- ``backend``       — the ``ChunkBackend`` contract + ``LocalFSBackend``
+- ``bucket``        — bucket/object-namespace backend, multipart uploads,
+                      ``FaultShim`` fault/latency injector
+- ``object_plane``  — chunks as owned cluster objects (vault actor)
+- ``pario``         — bounded-parallel chunk IO with verification
+- ``tiered``        — ``TieredStore``: local commits + async mirror pump,
+                      residency, eviction, read-through restore
+- ``sweeper``       — keep-last/pinned/grace retention across tiers
+"""
+
+from ray_tpu.ckpt.tier.backend import (BackendUnavailable, ChunkBackend,
+                                       LocalFSBackend,
+                                       backend_from_descriptor)
+from ray_tpu.ckpt.tier.bucket import (BucketBackend, DirBucketClient,
+                                      FaultShim)
+from ray_tpu.ckpt.tier.pario import (ChunkFetchError, ChunkVerifyError,
+                                     ParallelIO, coalesce_ranges)
+from ray_tpu.ckpt.tier.sweeper import SweepPolicy, sweep_registered, sweep_store
+from ray_tpu.ckpt.tier.tiered import TieredStore, attach
+
+__all__ = [
+    "BackendUnavailable",
+    "ChunkBackend",
+    "LocalFSBackend",
+    "BucketBackend",
+    "DirBucketClient",
+    "FaultShim",
+    "ObjectPlaneBackend",
+    "ChunkFetchError",
+    "ChunkVerifyError",
+    "ParallelIO",
+    "coalesce_ranges",
+    "TieredStore",
+    "attach",
+    "SweepPolicy",
+    "sweep_store",
+    "sweep_registered",
+    "backend_from_descriptor",
+]
+
+
+def __getattr__(name: str):
+    # ObjectPlaneBackend pulls in the worker/actor machinery; keep it
+    # lazy so offline tools can import the tier without a cluster stack
+    if name == "ObjectPlaneBackend":
+        from ray_tpu.ckpt.tier.object_plane import ObjectPlaneBackend
+
+        return ObjectPlaneBackend
+    raise AttributeError(f"module 'ray_tpu.ckpt.tier' has no attribute "
+                         f"{name!r}")

@@ -221,12 +221,19 @@ class DashboardHead:
 
     async def _checkpoints(self, request):
         """Checkpoint-plane stores: per-store latest/pinned ids, per-
-        checkpoint step/bytes/dedup stats and retention drop counters
-        (mirrored to the ``ckpt`` KV namespace by CheckpointStore on every
-        commit/pin/retention)."""
+        checkpoint step/bytes/dedup stats, retention drop counters and —
+        for tiered stores — per-checkpoint residency columns plus the
+        latest GCS sweeper report (``ckpt`` / ``ckpt_sweep`` KV
+        namespaces, mirrored by CheckpointStore/TieredStore and the
+        retention sweeper)."""
         from aiohttp import web
 
-        return web.json_response(await self._kv_namespace_dump("ckpt"))
+        stores = await self._kv_namespace_dump("ckpt")
+        sweeps = await self._kv_namespace_dump("ckpt_sweep")
+        for name, stats in stores.items():
+            if isinstance(stats, dict) and name in sweeps:
+                stats["last_sweep"] = sweeps[name]
+        return web.json_response(stores)
 
     async def _serve(self, request):
         """Serve autoscale plane: per-deployment replica target vs live

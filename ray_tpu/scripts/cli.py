@@ -70,11 +70,26 @@ def _connect(args):
     return ray_tpu
 
 
+def _status_payload():
+    """Fleet summary + per-job goodput column: the status view answers
+    "is the cluster healthy AND are the jobs on it productive" without a
+    second command. Goodput is best-effort — a cluster with no tagged
+    jobs (or a pre-goodput GCS) just shows an empty column."""
+    from ray_tpu.util.state import goodput, summarize_cluster
+
+    out = summarize_cluster()
+    try:
+        out["goodput"] = {
+            name: round(float(view.get("goodput_fraction", 0.0)), 4)
+            for name, view in sorted(goodput().items())}
+    except Exception:
+        out["goodput"] = {}
+    return out
+
+
 def cmd_status(args):
     _connect(args)
-    from ray_tpu.util.state import summarize_cluster
-
-    print(json.dumps(summarize_cluster(), indent=2))
+    print(json.dumps(_status_payload(), indent=2))
 
 
 def cmd_list(args):
@@ -301,11 +316,23 @@ def cmd_serve(args):
 
 
 def cmd_ckpt(args):
-    """ray-tpu ckpt: inspect checkpoint-plane stores (ray_tpu/ckpt/).
+    """ray-tpu ckpt: inspect and manage checkpoint-plane stores
+    (ray_tpu/ckpt/).
 
     With ``--root`` the subcommands operate directly on a store directory
     (no cluster needed); without it, ``list`` shows every store registered
-    with the GCS (KV ns ``ckpt``)."""
+    with the GCS (KV ns ``ckpt``). ``mirror``/``evict``/``verify`` drive
+    a tiered store's remote tier through its persisted TIER descriptor."""
+    if args.ckpt_command == "sweep":
+        # cluster-side: force the GCS retention sweeper over every
+        # registered store now and print its reports
+        _connect(args)
+        from ray_tpu._private import worker as worker_mod
+
+        core = worker_mod.global_worker()
+        out = core._run(core._gcs_call("CkptSweep", {}), 300.0)
+        print(json.dumps(out["reports"], indent=2, default=str))
+        return
     if not args.root:
         _connect(args)
         from ray_tpu.util.state import list_checkpoints
@@ -313,6 +340,22 @@ def cmd_ckpt(args):
         print(json.dumps(list_checkpoints(), indent=2, default=str))
         return
     from ray_tpu.ckpt import CheckpointStore, diff_manifests
+
+    if args.ckpt_command in ("mirror", "evict", "verify"):
+        from ray_tpu.ckpt.tier import attach
+
+        tiered = attach(args.root, mirror=False)
+        ckpt_id = getattr(args, "ckpt_id", "") or None
+        if args.ckpt_command == "mirror":
+            out = tiered.mirror_now(ckpt_id)
+        elif args.ckpt_command == "evict":
+            out = tiered.evict_local(ckpt_id or tiered.latest_id())
+        else:
+            out = tiered.verify(ckpt_id, deep=args.deep)
+        print(json.dumps(out, indent=2, default=str))
+        if args.ckpt_command == "verify" and not out.get("ok"):
+            sys.exit(1)
+        return
 
     store = CheckpointStore(args.root)
     if args.ckpt_command == "list":
@@ -477,6 +520,23 @@ def main(argv=None):
     cd.add_argument("--root", required=True)
     cd.add_argument("a")
     cd.add_argument("b")
+    cm = csub.add_parser("mirror", help="replicate a checkpoint to the "
+                                        "store's remote tier now")
+    cm.add_argument("--root", required=True)
+    cm.add_argument("ckpt_id", nargs="?", default="")
+    ce = csub.add_parser("evict", help="drop local chunk bytes of a "
+                                       "fully-mirrored checkpoint")
+    ce.add_argument("--root", required=True)
+    ce.add_argument("ckpt_id", nargs="?", default="")
+    cv = csub.add_parser("verify", help="check a checkpoint's remote "
+                                        "durability (exit 1 if not ok)")
+    cv.add_argument("--root", required=True)
+    cv.add_argument("ckpt_id", nargs="?", default="")
+    cv.add_argument("--deep", action="store_true",
+                    help="fetch and sha256-verify every chunk")
+    cs = csub.add_parser("sweep", help="force the GCS retention sweeper "
+                                       "over every registered store now")
+    cs.add_argument("--root", default="")  # unused; uniform surface
     p.set_defaults(fn=cmd_ckpt, ckpt_command="list", root="")
 
     p = sub.add_parser("microbenchmark", help="run the core perf suite")

@@ -79,12 +79,33 @@ def tree_to_dir(tree: Dict[str, Any], dest: str) -> str:
 
 
 def checkpoint_store(run_dir: str):
-    """The run's checkpoint-plane store (shared by workers + controller)."""
-    from ray_tpu.ckpt import CheckpointStore
+    """The run's checkpoint-plane store (shared by workers + controller).
 
-    return CheckpointStore(os.path.join(run_dir, "ckpts"),
-                           name=os.path.basename(os.path.abspath(run_dir))
-                           or "train")
+    Local-only by default. It becomes a :class:`~ray_tpu.ckpt.TieredStore`
+    when the run already carries a ``TIER`` descriptor (resuming a tiered
+    run re-attaches its backend) or when ``ckpt_tier_root`` (env
+    ``RAY_TPU_CKPT_TIER_ROOT``) names a bucket root — each run then
+    mirrors asynchronously into ``<tier_root>/<run_name>/`` and restores
+    read through the tiers, so a host that lost its local pool (or a
+    replacement host) still restores."""
+    from ray_tpu._private.config import RAY_CONFIG
+    from ray_tpu.ckpt import CheckpointStore
+    from ray_tpu.ckpt.tier.tiered import TIER_FILE
+
+    root = os.path.join(run_dir, "ckpts")
+    name = os.path.basename(os.path.abspath(run_dir)) or "train"
+    if os.path.exists(os.path.join(root, TIER_FILE)):
+        from ray_tpu.ckpt import TieredStore
+
+        return TieredStore(root, name=name)
+    tier_root = RAY_CONFIG.ckpt_tier_root
+    if tier_root:
+        from ray_tpu.ckpt import (BucketBackend, DirBucketClient,
+                                  TieredStore)
+
+        client = DirBucketClient(os.path.join(tier_root, name))
+        return TieredStore(root, name=name, backend=BucketBackend(client))
+    return CheckpointStore(root, name=name)
 
 
 class CheckpointManager:

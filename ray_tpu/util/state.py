@@ -220,10 +220,20 @@ def list_weight_stores() -> Dict[str, Any]:
 def list_checkpoints() -> Dict[str, Any]:
     """Checkpoint-plane stores registered with the GCS (reference surface:
     the dashboard's /api/checkpoints): per-store latest/pinned checkpoint
-    ids, per-checkpoint step/bytes/dedup stats and retention drop
-    counters — mirrored to GCS KV ns="ckpt" by CheckpointStore
-    (ray_tpu/ckpt/store.py) on every commit/pin/retention."""
+    ids, per-checkpoint step/bytes/dedup stats, retention drop counters
+    and — for tiered stores (ray_tpu/ckpt/tier) — per-checkpoint
+    residency (local/mirroring/remote/evicted), the remote backend
+    descriptor and mirror IO counters. Mirrored to GCS KV ns="ckpt" by
+    CheckpointStore/TieredStore on every commit/pin/retention/mirror."""
     return _kv_namespace_dump("ckpt")
+
+
+def ckpt_sweeps() -> Dict[str, Any]:
+    """Latest per-store retention-sweep reports from the GCS-side
+    checkpoint sweeper (ns="ckpt_sweep"): dropped manifest/chunk/byte
+    counts per tier, the applied policy, and errors. Populated every
+    ``ckpt_sweep_interval_s`` for stores that registered a sweep policy."""
+    return _kv_namespace_dump("ckpt_sweep")
 
 
 def serve_state() -> Dict[str, Any]:

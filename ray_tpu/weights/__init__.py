@@ -32,6 +32,7 @@ _EXPORTS = {
     "flatten_tree": "spec", "unflatten_tree": "spec",
     "WeightStore": "store", "WeightStoreActor": "store",
     "WeightSubscription": "store",
+    "load_durable": "store", "durable_versions": "store",
     "collective_reshard": "transport", "jax_reshard": "transport",
     "local_shards_of": "transport", "publish_host_shards": "transport",
     "pull_with_locals": "transport", "redistribute": "transport",
@@ -61,6 +62,8 @@ __all__ = [
     "WeightStore",
     "WeightStoreActor",
     "WeightSubscription",
+    "load_durable",
+    "durable_versions",
     "plan_reshard",
     "DcnCostModel",
     "RedistributionProgram",

@@ -131,11 +131,18 @@ class WeightStoreActor:
     executor threads, so object-plane calls are safe; only ``poll`` is
     async and costs no thread while parked)."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, durable_root: Optional[str] = None):
         self.name = name
         self._versions: Dict[int, dict] = {}
         self._latest = -1
         self._counter = 0
+        # optional cold tier: durable publishes additionally persist as
+        # PINNED checkpoint-plane manifests under this root (and ride a
+        # TieredStore's remote backend when the root is tiered) — a
+        # committed durable version then survives not just publisher
+        # death but full-cluster death
+        self._durable_root = durable_root
+        self._dstore: Optional[Any] = None
 
     # -- publish side --------------------------------------------------
 
@@ -274,14 +281,116 @@ class WeightStoreActor:
         v["committed"] = True
         if version > self._latest:
             self._latest = version
+        self._persist_durable(version)
         # bound retention: drop chunk refs of superseded versions (the
         # refcounter frees owned objects once nothing borrows them)
         committed = sorted(k for k, vv in self._versions.items()
                            if vv["committed"])
         for old in committed[:-_KEEP_VERSIONS]:
-            self._versions[old]["chunks"] = {}
-            self._versions[old]["retired"] = True
+            if not self._versions[old].get("retired"):
+                self._versions[old]["chunks"] = {}
+                self._versions[old]["retired"] = True
+                self._retire_durable(old)
         self._push_stats()
+
+    # -- durable cold tier (checkpoint-plane persistence) --------------
+
+    def _durable_store(self):
+        """Lazy handle on the cold-tier store: a TieredStore when the
+        root carries a TIER descriptor (durable versions then mirror to
+        the remote chunk backend), a plain CheckpointStore otherwise."""
+        if self._durable_root is None:
+            return None
+        if self._dstore is None:
+            import os
+
+            from ray_tpu.ckpt.store import CheckpointStore
+            from ray_tpu.ckpt.tier.tiered import TIER_FILE, TieredStore
+
+            root = self._durable_root
+            if os.path.exists(os.path.join(root, TIER_FILE)):
+                self._dstore = TieredStore(root, name=f"weights-{self.name}")
+            else:
+                self._dstore = CheckpointStore(
+                    root, name=f"weights-{self.name}")
+        return self._dstore
+
+    def _durable_ckpt_id(self, version: int) -> str:
+        return f"weights-{self.name}-v{int(version):010d}"
+
+    def _persist_durable(self, version: int):
+        """Mirror a fully-owned committed version into the checkpoint
+        plane as a PINNED manifest (``weights-<name>-v<version>``): each
+        chunk's stored (possibly quantized) bytes land content-addressed
+        in the chunk pool, geometry/encoding ride the manifest stats, and
+        the pin keeps retention and the cluster sweeper off the version
+        until :meth:`_retire_durable` releases it. Versions holding any
+        borrowed (zero-copy) ref are skipped — those bytes die with their
+        publisher, so persisting them would fake durability. Best-effort
+        by contract: cold-tier trouble must never fail a publish."""
+        store = self._durable_store()
+        if store is None:
+            return
+        v = self._versions[version]
+        if not v["chunks"] or any(not c.get("owned")
+                                  for c in v["chunks"].values()):
+            return
+        try:
+            from ray_tpu.ckpt import manifest as mf
+
+            leaves: Dict[str, Any] = {}
+            chunk_meta: Dict[str, dict] = {}
+            for key, c in sorted(v["chunks"].items()):
+                arr = np.ascontiguousarray(np.asarray(ray_tpu.get(c["ref"])))
+                data = arr.tobytes()
+                h, _created = mf.write_chunk(store.root, data)
+                # the manifest leaf is the stored byte payload (flat
+                # uint8, like a file leaf); real geometry + encoding live
+                # in stats so load_durable can rebuild the exact arrays
+                leaves[key] = mf.LeafEntry(
+                    kind=mf.ND, shape=(len(data),), dtype="|u1",
+                    chunks={mf.encode_box(((0, len(data)),)):
+                            (h, len(data))})
+                chunk_meta[key] = {
+                    "dtype": arr.dtype.str, "shape": list(arr.shape),
+                    "enc": c.get("enc"), "sha": c.get("sha", ""),
+                    "raw_nbytes": int(c.get("raw_nbytes", arr.nbytes))}
+            cid = self._durable_ckpt_id(version)
+            man = mf.Manifest(
+                ckpt_id=cid, step=int(version), ts=time.time(),
+                parent=None, skeleton=v["skeleton"], spec=v["spec"],
+                leaves=leaves,
+                stats={"weights_store": self.name,
+                       "weights_version": int(version),
+                       "chunks": chunk_meta})
+            # write + pin, WITHOUT moving LATEST: the root may be shared
+            # with a training checkpoint store whose restore-latest
+            # semantics a weight publish must not hijack
+            mf.write_manifest(store.root, man)
+            store.pin(cid)
+            enqueue = getattr(store, "enqueue_mirror", None)
+            if enqueue is not None:
+                enqueue(cid)
+            v["durable_ckpt_id"] = cid
+        except Exception as e:  # cold tier is best-effort by contract
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "weight store %s: durable persist of v%s failed: %r",
+                self.name, version, e)
+
+    def _retire_durable(self, version: int):
+        """Unpin a retired version's cold-tier manifest so retention /
+        the cluster sweeper may reclaim it (shared chunks stay as long
+        as any live manifest references them)."""
+        store = self._dstore  # never constructed just to unpin
+        cid = self._versions[version].pop("durable_ckpt_id", None)
+        if store is None or cid is None:
+            return
+        try:
+            store.unpin(cid)
+        except Exception:  # cold tier is best-effort by contract
+            pass
 
     def note_pull(self, version: int, nbytes: int) -> bool:
         v = self._versions.get(version)
@@ -328,17 +437,20 @@ class WeightStoreActor:
         return self._latest
 
     def stats(self) -> dict:
-        return {
+        out = {
             "name": self.name,
             "latest": self._latest,
             "versions": {
                 str(ver): {k: v.get(k, 0) for k in
                            ("committed", "ts", "num_chunks",
                             "bytes_published", "bytes_pulled", "num_pulls",
-                            "bytes_reused")}
+                            "bytes_reused", "durable_ckpt_id")}
                 for ver, v in sorted(self._versions.items())
             },
         }
+        if self._durable_root is not None:
+            out["durable_root"] = self._durable_root
+        return out
 
     def _push_stats(self):
         """Mirror stats into the GCS KV (``weights`` ns) for the dashboard.
@@ -390,14 +502,16 @@ class WeightSubscription:
 class WeightStore:
     """Process-local handle on a named weight store (create-or-attach)."""
 
-    def __init__(self, name: str, create: bool = True):
+    def __init__(self, name: str, create: bool = True,
+                 durable_root: Optional[str] = None):
         self.name = name
         actor_name = _STORE_PREFIX + name
         if create:
             actor_cls = ray_tpu.remote(WeightStoreActor)
             self._actor = actor_cls.options(
                 name=actor_name, lifetime="detached", get_if_exists=True,
-                max_concurrency=32, num_cpus=0.1).remote(name)
+                max_concurrency=32, num_cpus=0.1).remote(
+                    name, durable_root)
         else:
             self._actor = ray_tpu.get_actor(actor_name)
 
@@ -643,3 +757,96 @@ class WeightStore:
             ray_tpu.kill(self._actor)
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# cold-tier restore (no actor, no cluster): the full-restart path
+# ---------------------------------------------------------------------------
+
+
+def _attach_durable(root: str):
+    """Store handle on a durable-weights root: tiered when the root
+    carries a TIER descriptor (read-through to the remote backend, no
+    mirror pump), plain otherwise."""
+    import os
+
+    from ray_tpu.ckpt.store import CheckpointStore
+    from ray_tpu.ckpt.tier.tiered import TIER_FILE, TieredStore
+
+    if os.path.exists(os.path.join(root, TIER_FILE)):
+        return TieredStore(root, mirror=False)
+    return CheckpointStore(root)
+
+
+def _durable_index(store, name: Optional[str]) -> Dict[int, str]:
+    out: Dict[int, str] = {}
+    for man in store.list():
+        st = man.stats or {}
+        if "weights_version" not in st:
+            continue  # a training checkpoint sharing the root
+        if name is not None and st.get("weights_store") != name:
+            continue
+        out[int(st["weights_version"])] = man.ckpt_id
+    return out
+
+
+def durable_versions(root: str, name: Optional[str] = None) -> Dict[int, str]:
+    """Durable weight versions persisted under ``root`` as
+    ``{version: ckpt_id}`` — optionally filtered to one store ``name``
+    (a root may hold several stores, and training checkpoints besides)."""
+    return _durable_index(_attach_durable(root), name)
+
+
+def load_durable(root: str, name: Optional[str] = None,
+                 version: Optional[int] = None) -> Tuple[int, Any]:
+    """Rebuild a durable weight version from its cold-tier manifest with
+    NO store actor (and no cluster) alive — the full-restart path of
+    ``publish(..., durable=True)`` on a store with a ``durable_root``.
+    Chunk bytes read through the storage tiers (an evicted local pool
+    fetches from the remote backend, sha256-verified) and any quantized
+    encoding is undone. Returns ``(version, tree)`` for the newest
+    version, or the one requested."""
+    store = _attach_durable(root)
+    index = _durable_index(store, name)
+    if not index:
+        raise FileNotFoundError(
+            f"no durable weight versions under {root!r}"
+            + (f" for store {name!r}" if name else ""))
+    if version is None:
+        version = max(index)
+    cid = index.get(int(version))
+    if cid is None:
+        raise KeyError(f"no durable manifest for version {version} under "
+                       f"{root!r} (have {sorted(index)})")
+    man = store.read(cid)
+    spec = _spec_from_payload(man.spec)
+    meta = man.stats["chunks"]
+    key_hash: Dict[str, str] = {}
+    sizes: Dict[str, int] = {}
+    for key, entry in man.leaves.items():
+        h, n = next(iter(entry.chunks.values()))
+        key_hash[key] = h
+        sizes[h] = n
+    fetch = getattr(store, "fetch_chunks", None)
+    if fetch is not None:
+        blobs = fetch(sizes)
+    else:
+        from ray_tpu.ckpt import manifest as mf
+
+        blobs = {h: mf.read_chunk(store.root, h) for h in sizes}
+    by_leaf: Dict[str, List[Tuple[Box, np.ndarray]]] = {}
+    for key, h in key_hash.items():
+        leaf, box = _split_key(key)
+        info = meta[key]
+        arr = np.frombuffer(blobs[h], dtype=np.dtype(info["dtype"]))
+        arr = arr.reshape(tuple(info["shape"]))
+        arr = _decode_chunk(arr, {"enc": info.get("enc")})
+        by_leaf.setdefault(leaf, []).append((box, arr))
+    leaves: Dict[str, np.ndarray] = {}
+    for leaf, (shape, dtype) in spec.meta.items():
+        out = np.empty(shape, dtype=np.dtype(dtype))
+        for box, arr in by_leaf.get(leaf, ()):
+            out[box_slices(box)] = np.asarray(arr).reshape(
+                tuple(b - a for a, b in box))
+        leaves[leaf] = out
+    return int(version), unflatten_tree(man.skeleton, leaves)

@@ -1,0 +1,653 @@
+"""Storage-tier plane tests (ray_tpu/ckpt/tier/).
+
+Covers the tier's acceptance properties:
+(a) backend contract — LocalFS / bucket (+ object-plane, under a
+    cluster) behave identically behind ``ChunkBackend``, including
+    multipart uploads whose aborted halves are never visible;
+(b) parallel IO — bounded fetch with sha256 verification (corrupt remote
+    bytes are *rejected*, with per-chunk fallback to the local tier),
+    range coalescing, in-flight byte-cap progress;
+(c) crash/fault lifecycle — a mirror pump killed mid-upload never
+    reports residency ``remote``; re-mirroring is idempotent by content
+    address and uploads only the remainder;
+(d) retention sweeper — never reaps a chunk reachable from a pinned or
+    in-flight (part-file) manifest, on either tier, regardless of age;
+(e) elastic restore-through-the-tier — a 4-host sharded save mirrors,
+    evicts locally, and restores byte-exact onto a 2-host mesh pulling
+    ONLY the intersecting chunks from the remote tier (per-host byte
+    and per-op accounting).
+"""
+
+import hashlib
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import ckpt
+from ray_tpu.ckpt import manifest as mf
+from ray_tpu.ckpt.tier.backend import (
+    BackendUnavailable,
+    backend_from_descriptor,
+)
+from ray_tpu.ckpt.tier.pario import (
+    ChunkFetchError,
+    ParallelIO,
+    coalesce_ranges,
+)
+from ray_tpu.ckpt.tier.sweeper import SweepPolicy, sweep_store
+from ray_tpu.weights.spec import (
+    MeshSpec,
+    ShardedTreeSpec,
+    box_slices,
+    host_boxes,
+)
+
+
+def _tree(scale: float = 1.0, leaves: int = 6, n: int = 256):
+    # distinct content per leaf: content addressing must not collapse
+    # the tree into one chunk
+    return {f"layer{i}": np.arange(n, dtype=np.float32) * scale + i
+            for i in range(leaves)}
+
+
+def _bucket_store(tmp_path, name, **kw):
+    shim = ckpt.FaultShim(ckpt.DirBucketClient(str(tmp_path / "bucket")))
+    store = ckpt.TieredStore(str(tmp_path / name), name=name,
+                             backend=ckpt.BucketBackend(shim), **kw)
+    return store, shim
+
+
+# ---------------------------------------------------------------------------
+# (a) backend contract
+# ---------------------------------------------------------------------------
+
+
+def _backends(tmp_path):
+    return [
+        ckpt.LocalFSBackend(str(tmp_path / "localfs")),
+        ckpt.BucketBackend(ckpt.DirBucketClient(str(tmp_path / "bucket")),
+                           prefix="tierA"),
+    ]
+
+
+def test_backend_contract(tmp_path):
+    data = b"tier chunk payload bytes"
+    h = hashlib.sha256(data).hexdigest()
+    for backend in _backends(tmp_path):
+        assert backend.put(h, data) is True
+        assert backend.put(h, data) is False  # content-addressed dedup
+        assert backend.has(h)
+        assert backend.get(h) == data
+        assert backend.get(h, offset=5, length=7) == data[5:12]
+        assert backend.list_chunks() == {h: len(data)}
+        mt = backend.chunk_mtime(h)
+        assert mt is not None and abs(mt - time.time()) < 60
+        with pytest.raises(KeyError):
+            backend.get("0" * 64)
+        # manifests ride the same contract
+        backend.put_manifest("step0000000001-aa", b'{"x": 1}')
+        assert backend.get_manifest("step0000000001-aa") == b'{"x": 1}'
+        assert backend.list_manifests() == ["step0000000001-aa"]
+        with pytest.raises(KeyError):
+            backend.get_manifest("step0000000009-zz")
+        st = backend.stats()
+        assert st["num_chunks"] == 1 and st["chunk_bytes"] == len(data)
+        # descriptor round-trip: an equivalent backend in another process
+        clone = backend_from_descriptor(backend.descriptor())
+        assert clone.has(h) and clone.get(h) == data
+        assert clone.list_manifests() == ["step0000000001-aa"]
+        backend.delete(h)
+        assert not backend.has(h)
+        backend.delete(h)  # idempotent
+        backend.delete_manifest("step0000000001-aa")
+        assert backend.list_manifests() == []
+
+
+def test_bucket_multipart_upload_and_aborted_invisible(tmp_path):
+    shim = ckpt.FaultShim(ckpt.DirBucketClient(str(tmp_path / "b")))
+    backend = ckpt.BucketBackend(shim, multipart_bytes=1024)
+    data = bytes(range(256)) * 20  # 5120 B: 5 parts above the threshold
+    h = hashlib.sha256(data).hexdigest()
+    assert backend.put(h, data) is True
+    assert shim.ops("create_multipart") == 1
+    assert shim.ops("upload_part") == 5
+    assert shim.ops("complete_multipart") == 1
+    assert backend.get(h) == data
+    # ranged read across a part boundary
+    assert backend.get(h, offset=1000, length=100) == data[1000:1100]
+
+    # a multipart that dies mid-part is aborted and never visible
+    shim.fail_after = shim.ops("upload_part") + 2
+    shim.fail_ops = ("upload_part",)
+    data2 = bytes(reversed(data))
+    h2 = hashlib.sha256(data2).hexdigest()
+    with pytest.raises(BackendUnavailable):
+        backend.put(h2, data2)
+    assert not backend.has(h2)
+    assert backend.list_chunks() == {h: len(data)}
+    # no staging leftovers leak into the object listing
+    assert all("multipart" not in k
+               for k in shim.client.list_objects(""))
+
+
+# ---------------------------------------------------------------------------
+# (b) parallel IO: coalescing, verification, byte-cap progress
+# ---------------------------------------------------------------------------
+
+
+def test_coalesce_ranges():
+    assert coalesce_ranges([], 64) == []
+    # unsorted input, overlapping + gap-mergeable spans
+    out = coalesce_ranges([(100, 10), (0, 10), (15, 5), (300, 8)], gap=8)
+    assert out == [(0, 20), (100, 10), (300, 8)]
+    # zero-length ranges drop; gap=0 merges only touching spans
+    assert coalesce_ranges([(0, 4), (4, 4), (9, 4), (2, 0)], gap=0) == [
+        (0, 8), (9, 4)]
+
+
+def test_parallel_fetch_verifies_and_reports_per_chunk(tmp_path):
+    root = str(tmp_path / "pool")
+    backend = ckpt.LocalFSBackend(root)
+    sizes = {}
+    datas = {}
+    for i in range(8):
+        data = bytes([i]) * 100
+        h, created = mf.write_chunk(root, data)
+        assert created
+        sizes[h] = len(data)
+        datas[h] = data
+    # cap far below the batch total: workers queue on the gate but every
+    # chunk still lands (progress is guaranteed, an oversized chunk is
+    # admitted alone)
+    io = ParallelIO(backend, threads=4, inflight_bytes=150, coalesce_gap=16)
+    out = io.fetch(dict(sizes))
+    assert out == datas
+    assert io.counters["fetch_chunks"] == 8
+    # corrupt ONE chunk on disk: the fetch rejects it by sha256 and the
+    # other seven arrive as the verified partial result
+    bad = sorted(sizes)[0]
+    with open(mf.chunk_path(root, bad), "wb") as f:
+        f.write(b"\xff" + datas[bad][1:])
+    with pytest.raises(ChunkFetchError) as ei:
+        io.fetch(dict(sizes))
+    assert set(ei.value.errors) == {bad}
+    assert len(ei.value.partial) == 7
+    assert ei.value.partial[sorted(sizes)[1]] == datas[sorted(sizes)[1]]
+    assert io.counters["verify_failures"] == 1
+
+
+def test_read_ranges_coalesces_round_trips(tmp_path):
+    shim = ckpt.FaultShim(ckpt.DirBucketClient(str(tmp_path / "b")))
+    backend = ckpt.BucketBackend(shim)
+    data = bytes(i % 251 for i in range(4096))
+    h = hashlib.sha256(data).hexdigest()
+    backend.put(h, data)
+    io = ParallelIO(backend, threads=2, coalesce_gap=64)
+    before = shim.ops("get")
+    ranges = [(0, 16), (40, 16), (2000, 32), (3000, 8)]
+    out = io.read_ranges(h, ranges)
+    assert out == [data[off:off + ln] for off, ln in ranges]
+    # (0,16)+(40,16) coalesce (gap 24 <= 64); the far two stay separate
+    assert shim.ops("get") - before == 3
+
+
+# ---------------------------------------------------------------------------
+# tiered lifecycle: commit -> mirror pump -> evict -> read-through restore
+# ---------------------------------------------------------------------------
+
+
+def test_tier_smoke_save_mirror_evict_restore(tmp_path):
+    """Tier-1 smoke: async save -> pump mirrors -> evict local bytes ->
+    restore pulls from the (fault-shimmed) remote tier, byte-exact."""
+    store, shim = _bucket_store(tmp_path, "smoke", mirror=True)
+    try:
+        tree = _tree(1.5)
+        man = ckpt.save_checkpoint(store, tree, step=1)
+        entry = store.wait_mirrored(man.ckpt_id, timeout=30.0)
+        assert entry["state"] == "remote"
+        assert entry["upload_chunks"] == len(man.chunk_set())
+        assert store.verify(man.ckpt_id)["ok"]
+        out = store.evict_local(man.ckpt_id)
+        assert out["evicted_chunks"] == len(man.chunk_set())
+        for h in man.chunk_set():
+            assert not os.path.exists(mf.chunk_path(store.root, h))
+        assert store.residency()[man.ckpt_id]["evicted"]
+        restored = ckpt.restore_tree(store)
+        for k, arr in tree.items():
+            np.testing.assert_array_equal(restored[k], arr)
+        # read-through cached the chunks back into the local pool
+        for h in man.chunk_set():
+            assert os.path.exists(mf.chunk_path(store.root, h))
+        # residency rides the store stats for the state API / dashboard
+        rows = {r["ckpt_id"]: r for r in store.stats()["checkpoints"]}
+        assert rows[man.ckpt_id]["residency"] == "evicted"
+    finally:
+        store.close()
+
+
+def test_mirror_dedup_across_steps(tmp_path):
+    store, shim = _bucket_store(tmp_path, "dedup", mirror=False)
+    try:
+        tree = _tree(2.0)
+        m1 = ckpt.save_checkpoint(store, tree, step=1)
+        c1 = store.mirror_now(m1.ckpt_id)
+        assert c1["upload_chunks"] == len(m1.chunk_set())
+        assert c1["dedup_chunks"] == 0
+        tree["layer0"] = tree["layer0"] + 0.25  # 1-of-6 delta (no other
+        # layer's content collides with a fractional shift)
+        m2 = ckpt.save_checkpoint(store, tree, step=2)
+        c2 = store.mirror_now(m2.ckpt_id)
+        assert c2["upload_chunks"] == 1  # only the changed leaf moves
+        assert c2["dedup_chunks"] == len(m2.chunk_set()) - 1
+        # re-mirroring an already-remote checkpoint uploads nothing
+        c3 = store.mirror_now(m2.ckpt_id)
+        assert c3["upload_chunks"] == 0
+        assert c3["dedup_chunks"] == len(m2.chunk_set())
+    finally:
+        store.close()
+
+
+def test_pump_killed_mid_upload_never_remote_then_idempotent(tmp_path):
+    """(c) the crash contract: a mirror that dies mid-upload leaves
+    residency ``mirroring`` (never ``remote``), uploads no manifest, and
+    an explicit re-mirror after the fault clears uploads only the
+    chunks the first attempt did not land."""
+    store, shim = _bucket_store(tmp_path, "crash", mirror=True,
+                                io_threads=2)
+    try:
+        # let 2 chunk uploads through, then the backend "dies"
+        shim.fail_after = 2
+        shim.fail_ops = ("put",)
+        man = ckpt.save_checkpoint(store, _tree(3.0), step=1)
+        total = len(man.chunk_set())
+        assert total == 6
+        with pytest.raises(RuntimeError, match="mirror of"):
+            store.wait_mirrored(man.ckpt_id, timeout=30.0)
+        entry = store.residency()[man.ckpt_id]
+        assert entry["state"] == "mirroring"  # never presented as durable
+        assert "BackendUnavailable" in entry["error"]
+        # the partially-uploaded checkpoint has NO remote manifest: a
+        # remote reader can never see a checkpoint missing its chunks
+        assert store.backend.list_manifests() == []
+        landed = len(store.backend.list_chunks())
+        assert 0 < landed < total
+
+        # fault clears -> re-mirror is idempotent by content address
+        shim.clear_fault()
+        c = store.mirror_now(man.ckpt_id)
+        assert c["upload_chunks"] == total - landed  # only the remainder
+        assert c["dedup_chunks"] == landed
+        assert store.residency()[man.ckpt_id]["state"] == "remote"
+        assert store.verify(man.ckpt_id, deep=True)["ok"]
+    finally:
+        store.close()
+
+
+def test_corrupt_remote_rejected_with_local_fallback(tmp_path):
+    store, shim = _bucket_store(tmp_path, "corrupt", mirror=False)
+    try:
+        tree = _tree(4.0)
+        man = ckpt.save_checkpoint(store, tree, step=1)
+        store.mirror_now(man.ckpt_id)
+        sizes = man.chunk_set()
+        shim.corrupt_get = lambda key: "chunks/" in key
+        # deep verify detects every corrupted chunk
+        report = store.verify(man.ckpt_id, deep=True)
+        assert not report["ok"]
+        assert report["corrupt_chunks"] == len(sizes)
+        # prefer="remote" (verification-style read) falls back per chunk
+        # to the intact local copy instead of failing the batch
+        out = store.fetch_chunks(dict(sizes), prefer="remote")
+        for h in sizes:
+            assert hashlib.sha256(out[h]).hexdigest() == h
+        assert store.io.counters["verify_failures"] >= len(sizes)
+        # with the local copy evicted too, corrupt bytes are an ERROR —
+        # never a silently-wrong restore
+        shim.corrupt_get = False
+        store.evict_local(man.ckpt_id)
+        shim.corrupt_get = lambda key: "chunks/" in key
+        with pytest.raises(ChunkFetchError):
+            ckpt.restore_tree(store, man.ckpt_id)
+        shim.corrupt_get = False
+        restored = ckpt.restore_tree(store, man.ckpt_id)
+        for k, arr in tree.items():
+            np.testing.assert_array_equal(restored[k], arr)
+    finally:
+        store.close()
+
+
+def test_evict_refuses_unmirrored_and_lossy_remote(tmp_path):
+    store, _shim = _bucket_store(tmp_path, "evict", mirror=False)
+    try:
+        m1 = ckpt.save_checkpoint(store, _tree(1.0), step=1)
+        with pytest.raises(ValueError, match="refusing to evict"):
+            store.evict_local(m1.ckpt_id)  # residency is local
+        store.mirror_now(m1.ckpt_id)
+        # the remote tier losing a chunk blocks eviction of the only copy
+        lost = sorted(m1.chunk_set())[0]
+        store.backend.delete(lost)
+        with pytest.raises(RuntimeError, match="remote tier lost"):
+            store.evict_local(m1.ckpt_id)
+        store.io.put_many({lost: mf.read_chunk(store.root, lost)})
+
+        # chunks shared with a local-resident checkpoint survive eviction
+        tree2 = _tree(1.0)
+        tree2["layer0"] = tree2["layer0"] + 0.25
+        m2 = ckpt.save_checkpoint(store, tree2, step=2)
+        store.mirror_now(m2.ckpt_id)
+        store.evict_local(m2.ckpt_id)
+        shared = set(m1.chunk_set()) & set(m2.chunk_set())
+        assert shared
+        for h in shared:  # m1 is still local-resident and needs them
+            assert os.path.exists(mf.chunk_path(store.root, h))
+        only_m2 = set(m2.chunk_set()) - set(m1.chunk_set())
+        for h in only_m2:
+            assert not os.path.exists(mf.chunk_path(store.root, h))
+    finally:
+        store.close()
+
+
+def test_adopt_remote_on_fresh_host(tmp_path):
+    store, _ = _bucket_store(tmp_path, "origin", mirror=False)
+    tree = _tree(5.0)
+    man = ckpt.save_checkpoint(store, tree, step=3)
+    store.mirror_now(man.ckpt_id)
+    store.close()
+    # a replacement host attaches to the same bucket with an empty root
+    fresh = ckpt.TieredStore(
+        str(tmp_path / "fresh"), name="fresh", mirror=False,
+        backend=ckpt.BucketBackend(
+            ckpt.DirBucketClient(str(tmp_path / "bucket"))))
+    try:
+        adopted = fresh.adopt_remote()
+        assert adopted == [man.ckpt_id]
+        entry = fresh.residency()[man.ckpt_id]
+        assert entry["state"] == "remote" and entry["evicted"]
+        restored = ckpt.restore_tree(fresh, man.ckpt_id)
+        for k, arr in tree.items():
+            np.testing.assert_array_equal(restored[k], arr)
+    finally:
+        fresh.close()
+
+
+# ---------------------------------------------------------------------------
+# (d) retention sweeper: pinned / in-flight / grace invariants
+# ---------------------------------------------------------------------------
+
+
+def test_sweeper_keep_last_both_tiers_protects_pins_and_inflight(tmp_path):
+    store, _ = _bucket_store(tmp_path, "sweep", mirror=False)
+    ids = []
+    for i in range(3):
+        m = ckpt.save_checkpoint(store, _tree(float(i + 1)), step=i)
+        store.mirror_now(m.ckpt_id)
+        ids.append(m.ckpt_id)
+    store.pin(ids[0])
+
+    # a pinned auxiliary manifest outside the LATEST chain — the weight
+    # plane's durable publish shape (write_manifest + pin, no commit)
+    data = b"durable weights payload"
+    wh, _ = mf.write_chunk(store.root, data)
+    wman = mf.Manifest(
+        ckpt_id="weights-pol-v0000000001", step=1, ts=time.time(),
+        parent=None, skeleton={"__leaf__": "w"}, spec=None,
+        leaves={"w": mf.LeafEntry(
+            kind=mf.ND, shape=(len(data),), dtype="|u1",
+            chunks={mf.encode_box(((0, len(data)),)): (wh, len(data))})},
+        stats={"weights_store": "pol", "weights_version": 1})
+    mf.write_manifest(store.root, wman)
+    store.pin(wman.ckpt_id)
+    store.mirror_now(wman.ckpt_id)
+    assert store.latest_id() == ids[-1]  # durable publish moved no LATEST
+
+    # an in-flight sharded save: a part-file referencing an orphan chunk
+    # far older than any grace window
+    orphan, _ = mf.write_chunk(store.root, b"slow peer host chunk")
+    old = time.time() - 86400
+    os.utime(mf.chunk_path(store.root, orphan), (old, old))
+    part_dir = os.path.join(store.root, mf.PART_DIR, "step0000000099-beef")
+    os.makedirs(part_dir)
+    mf.atomic_write(
+        os.path.join(part_dir, "step0000000099-beef.rank3.json"),
+        json.dumps({"host": "rank3", "leaves": {
+            "opt/m": {"((0, 4), (0, 4))": [orphan, 20]}}}).encode())
+    # plus a plain orphan chunk, equally old, protected by NOTHING
+    doomed, _ = mf.write_chunk(store.root, b"no manifest ever named me")
+    os.utime(mf.chunk_path(store.root, doomed), (old, old))
+    store.close()
+
+    report = sweep_store(store.root, SweepPolicy(keep_last=1, grace_s=0))
+    # keep-last=1 drops ids[1]; ids[0] is pinned, ids[2] is newest, and
+    # the pinned weights manifest does NOT consume the keep-last slot
+    assert report["local"]["dropped_manifests"] == 1
+    assert report["remote"]["dropped_manifests"] == 1
+    survivor = ckpt.TieredStore(store.root, mirror=False)
+    try:
+        left = survivor.list_ids()
+        assert ids[0] in left and ids[2] in left and wman.ckpt_id in left
+        assert ids[1] not in left
+        assert ids[1] not in survivor.backend.list_manifests()
+        # pinned checkpoints still restore from both tiers
+        np.testing.assert_array_equal(
+            ckpt.restore_tree(survivor, ids[0])["layer0"],
+            _tree(1.0)["layer0"])
+        assert survivor.backend.get(wh) == data
+        # the in-flight chunk survived (part-file protection beats age);
+        # the unprotected orphan was reaped
+        assert os.path.exists(mf.chunk_path(store.root, orphan))
+        assert not os.path.exists(mf.chunk_path(store.root, doomed))
+
+        # the save commits (part-file gone) -> the orphan loses its
+        # protection and the next zero-grace sweep reaps it
+        import shutil
+
+        shutil.rmtree(os.path.dirname(part_dir))
+        sweep_store(store.root, SweepPolicy(keep_last=1, grace_s=0))
+        assert not os.path.exists(mf.chunk_path(store.root, orphan))
+    finally:
+        survivor.close()
+
+
+def test_sweeper_grace_window_spares_young_remote_orphans(tmp_path):
+    store, _ = _bucket_store(tmp_path, "grace", mirror=False)
+    m = ckpt.save_checkpoint(store, _tree(1.0), step=1)
+    store.mirror_now(m.ckpt_id)
+    # a just-uploaded remote orphan: an in-flight mirror of a checkpoint
+    # whose remote manifest has not landed yet
+    data = b"mid-mirror remote chunk"
+    h = hashlib.sha256(data).hexdigest()
+    store.backend.put(h, data)
+    store.close()
+    sweep_store(store.root, SweepPolicy(keep_last=None, grace_s=3600))
+    backend = ckpt.BucketBackend(
+        ckpt.DirBucketClient(str(tmp_path / "bucket")))
+    assert backend.has(h)  # young: spared
+    sweep_store(store.root, SweepPolicy(keep_last=None, grace_s=0))
+    assert not backend.has(h)  # grace disabled, nothing references it
+    # the mirrored checkpoint's chunks were live throughout
+    for ch in m.chunk_set():
+        assert backend.has(ch)
+
+
+def test_retention_keep_last_ignores_pinned_aux_manifests(tmp_path):
+    """Regression: a pinned ``weights-*`` manifest sorts after every
+    ``step*`` id and must not consume the keep-last slot (which would
+    evict the newest real checkpoint)."""
+    store = ckpt.CheckpointStore(str(tmp_path), name="kl")
+    ids = [ckpt.save_checkpoint(store, _tree(float(i)), step=i).ckpt_id
+           for i in range(2)]
+    aux = mf.Manifest(ckpt_id="weights-kl-v0000000007", step=7,
+                      ts=time.time(), parent=None,
+                      skeleton={"__leaf__": "w"}, spec=None, leaves={})
+    mf.write_manifest(store.root, aux)
+    store.pin(aux.ckpt_id)
+    store.retention(keep_last=1, grace_s=0)
+    left = store.list_ids()
+    assert ids[1] in left  # the newest training ckpt survived
+    assert aux.ckpt_id in left
+    assert ids[0] not in left
+
+
+# ---------------------------------------------------------------------------
+# (e) elastic 4 -> 2 restore THROUGH the tier: per-host chunk accounting
+# ---------------------------------------------------------------------------
+
+
+def _sharded_spec(num_hosts):
+    mesh = MeshSpec((num_hosts,), ("data",),
+                    tuple(f"rank{i}" for i in range(num_hosts)))
+    return ShardedTreeSpec(
+        mesh=mesh,
+        parts={"opt/m": ("data", None), "opt/v": ("data", None)},
+        meta={"opt/m": ((8, 4), "<f4"), "opt/v": ((8, 4), "<f4")})
+
+
+def _global_tree():
+    return {"opt/m": np.arange(32, dtype=np.float32).reshape(8, 4),
+            "opt/v": np.arange(32, 64, dtype=np.float32).reshape(8, 4)}
+
+
+def test_sharded_save_mirror_evict_restore_2host_accounting(tmp_path):
+    store, shim = _bucket_store(tmp_path, "elastic", mirror=False)
+    try:
+        spec4 = _sharded_spec(4)
+        cid = ckpt.new_ckpt_id(7)
+        full = _global_tree()
+        for host in spec4.mesh.hosts:
+            shards = {}
+            for leaf in spec4.meta:
+                box = host_boxes(spec4.mesh, spec4.part_of(leaf),
+                                 spec4.meta[leaf][0], host)[0]
+                shards[leaf] = {box: full[leaf][box_slices(box)]}
+            ckpt.save_host_shards(store, cid, spec4, host, shards, step=7)
+        man = ckpt.commit_host_parts(store, cid, spec4, step=7)
+        assert man.ckpt_id == cid
+        assert len(man.chunk_set()) == 8  # 4 boxes x 2 leaves
+        store.mirror_now(cid)
+        store.evict_local(cid)
+        for h in man.chunk_set():
+            assert not os.path.exists(mf.chunk_path(store.root, h))
+
+        spec2 = _sharded_spec(2)
+        total = sum(a.nbytes for a in full.values())
+        for rank, host in enumerate(spec2.mesh.hosts):
+            gets_before = shim.ops("get")
+            shards, stats = ckpt.restore_shards(store, spec2, host, cid)
+            assert stats["no_gather"]
+            # each of the 2 hosts reads exactly its half of every leaf...
+            assert stats["bytes_read"] == total // 2
+            # ...as exactly the 4 intersecting remote chunks — the other
+            # host's half is never fetched (ranks' source boxes are
+            # disjoint, so the read-through cache cannot help either)
+            assert stats["chunks_read"] == 4
+            assert shim.ops("get") - gets_before == 4
+            for leaf, arr in full.items():
+                (box, shard), = shards[leaf].items()
+                np.testing.assert_array_equal(
+                    shard, arr[rank * 4:(rank + 1) * 4])
+    finally:
+        store.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster surface: object-plane tier + GCS sweep RPC + state API
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+def test_object_plane_backend_tier(cluster, tmp_path):
+    backend = ckpt.ObjectPlaneBackend("tier_test")
+    data = b"object plane chunk bytes"
+    h = hashlib.sha256(data).hexdigest()
+    assert backend.put(h, data) is True
+    assert backend.put(h, data) is False
+    assert backend.get(h) == data
+    assert backend.get(h, offset=7, length=5) == data[7:12]
+    assert backend.has(h)
+    assert backend.list_chunks() == {h: len(data)}
+    assert backend.chunk_mtime(h) is not None
+    with pytest.raises(KeyError):
+        backend.get("0" * 64)
+    backend.delete(h)
+    assert not backend.has(h)
+
+    # a checkpoint mirrored into the cluster restores after local evict:
+    # the vault actor owns the refs, not the saving worker
+    store = ckpt.TieredStore(str(tmp_path / "op"), name="op-tier",
+                             mirror=False, backend=backend)
+    try:
+        tree = _tree(6.0)
+        man = ckpt.save_checkpoint(store, tree, step=1)
+        store.mirror_now(man.ckpt_id)
+        store.evict_local(man.ckpt_id)
+        restored = ckpt.restore_tree(store, man.ckpt_id)
+        for k, arr in tree.items():
+            np.testing.assert_array_equal(restored[k], arr)
+    finally:
+        store.close()
+
+
+def test_gcs_sweep_rpc_and_state_surface(cluster, tmp_path):
+    shim = ckpt.FaultShim(ckpt.DirBucketClient(str(tmp_path / "swb")))
+    store = ckpt.TieredStore(str(tmp_path / "swroot"), name="swept-store",
+                             mirror=False, backend=ckpt.BucketBackend(shim),
+                             sweep={"keep_last": 1, "grace_s": 0})
+    ids = []
+    for i in range(3):
+        m = ckpt.save_checkpoint(store, _tree(float(i + 1)), step=i)
+        store.mirror_now(m.ckpt_id)
+        ids.append(m.ckpt_id)
+    store.mirror()  # stats (incl. the sweep policy + residency) -> KV
+
+    from ray_tpu.util import state
+
+    listed = state.list_checkpoints()["swept-store"]
+    assert listed["sweep"] == {"keep_last": 1, "grace_s": 0}
+    assert listed["tier"]["residency_summary"] == {"remote": 3}
+
+    core = state._core()
+    out = core._run(core._gcs_call("CkptSweep", {}), 60.0)
+    reports = [r for r in out["reports"] if r["name"] == "swept-store"]
+    assert len(reports) == 1
+    assert reports[0]["local"]["dropped_manifests"] == 2
+    assert store.list_ids() == [ids[2]]
+    # the report is queryable back out of the state API
+    swept = state.ckpt_sweeps()["swept-store"]
+    assert swept["dropped_manifests"] >= 2
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI: the status view's goodput column
+# ---------------------------------------------------------------------------
+
+
+def test_status_payload_goodput_column(monkeypatch):
+    from ray_tpu.scripts import cli
+    from ray_tpu.util import state
+
+    monkeypatch.setattr(state, "summarize_cluster",
+                        lambda: {"nodes": {"alive": 1}})
+    monkeypatch.setattr(state, "goodput", lambda: {
+        "trainA": {"goodput_fraction": 0.75321, "wall_s": 10.0},
+        "tuneB": {"goodput_fraction": 0.5}})
+    out = cli._status_payload()
+    assert out["nodes"] == {"alive": 1}
+    assert out["goodput"] == {"trainA": 0.7532, "tuneB": 0.5}
+
+    def _boom():
+        raise RuntimeError("pre-goodput GCS")
+
+    monkeypatch.setattr(state, "goodput", _boom)
+    assert cli._status_payload()["goodput"] == {}

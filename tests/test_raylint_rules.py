@@ -801,6 +801,78 @@ def test_ckp001_nonconstant_mode_is_conservative():
     assert rules_of(findings) == ["CKP001"]
 
 
+def test_ckp001_backend_write_method_with_fsync_rename_is_exempt():
+    # a storage backend's designated write chokepoint may write directly —
+    # when the method itself upholds the temp+fsync+rename contract
+    findings = lint("""
+        import os
+
+        class DirBucketClient:
+            def put_object(self, key, data):
+                tmp = key + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    os.fsync(f.fileno())
+                os.replace(tmp, key)
+
+        class BucketBackend:
+            def complete_multipart(self, parts, path):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as out:
+                    for p in parts:
+                        with open(p, "rb") as f:
+                            out.write(f.read())
+                    os.fsync(out.fileno())
+                os.replace(tmp, path)
+    """, relpath="ray_tpu/ckpt/tier/bucket.py", rules=["CKP001"])
+    assert rules_of(findings) == []
+
+
+def test_ckp001_backend_write_method_without_contract_flags():
+    # same chokepoint method, but no fsync+rename: the exemption does not
+    # apply — a torn backend object is as fatal as a torn manifest
+    findings = lint("""
+        import os
+
+        class FlakyBackend:
+            def put(self, h, data):
+                with open(h, "wb") as f:
+                    f.write(data)
+    """, relpath="ray_tpu/ckpt/tier/flaky.py", rules=["CKP001"])
+    assert rules_of(findings) == ["CKP001"]
+
+
+def test_ckp001_backend_nonwrite_method_and_nonbackend_class_flag():
+    findings = lint("""
+        import os
+
+        class DirBucketClient:
+            def snapshot(self, path, data):  # not a designated write method
+                with open(path, "wb") as f:
+                    f.write(data)
+                    os.fsync(f.fileno())
+                os.replace(path, path + ".bak")
+
+        class Indexer:  # not a Backend/BucketClient class
+            def put_object(self, path, data):
+                with open(path, "wb") as f:
+                    f.write(data)
+                    os.fsync(f.fileno())
+                os.replace(path, path + ".new")
+    """, relpath="ray_tpu/ckpt/tier/bucket.py", rules=["CKP001"])
+    assert rules_of(findings) == ["CKP001"] * 2
+
+
+def test_ckp001_backend_suppression():
+    findings = lint("""
+        class RamBackend:
+            def put(self, h, data):
+                with open("/dev/shm/" + h, "wb") as f:  # raylint: disable=CKP001 tmpfs scratch tier, loss is by design
+                    f.write(data)
+    """, relpath="ray_tpu/ckpt/tier/ram.py", rules=["CKP001"])
+    assert rules_of(findings) == []
+
+
 # ---------------------------------------------------------------------------
 # ASY004 — transitive blocking calls (graph-based; generalizes ASY001)
 # ---------------------------------------------------------------------------

@@ -15,8 +15,20 @@ hold the line on "a save never stalls the step":
 * ``shard_restore_mb_s``     — per-host sharded restore throughput (4->2
                                reshard through the planner)
 
-Emits one JSON object on stdout (plus --out FILE) so BENCH rounds can
-track regressions. No cluster needed.
+``--tier`` adds the storage-tier plane (ckpt/tier) on a latency-shimmed
+bucket backend (FaultShim, 5 ms/op — an object store across a DC hop):
+
+* ``tier_mirror_mb_s``             — first mirror (all chunks upload)
+* ``tier_mirror_dedup_ratio``      — re-mirror after a 1/8 delta: bytes
+                                     skipped by content-address dedup
+* ``tier_restore_parallel_mb_s``   — restore-from-remote, local pool
+                                     evicted, parallel chunk IO
+* ``tier_restore_serial_mb_s``     — same restore forced single-thread
+* ``tier_parallel_speedup``        — parallel / serial (gate: >= 2x)
+
+Emits one JSON object on stdout (plus --out FILE) so CKPT rounds can
+track regressions (tools/benchtrack.py family "CKPT"). No cluster
+needed.
 """
 
 from __future__ import annotations
@@ -157,12 +169,76 @@ def bench_restore(root: str, state):
     }
 
 
+def bench_tier(root: str, state, threads: int = 8,
+               latency_s: float = 0.005):
+    """Storage-tier plane: mirror throughput + cross-step upload dedup,
+    then restore-from-remote (local pool evicted) parallel vs serial
+    through a latency-shimmed bucket backend."""
+    from ray_tpu import ckpt
+
+    shim = ckpt.FaultShim(ckpt.DirBucketClient(f"{root}/bucket"),
+                          latency_s=latency_s)
+
+    def _attach(n):
+        return ckpt.TieredStore(f"{root}/tier", name="bench-tier",
+                                mirror=False,
+                                backend=ckpt.BucketBackend(shim),
+                                io_threads=n)
+
+    store = _attach(threads)
+    man1 = ckpt.save_checkpoint(store, state, step=1)
+    mb = _mb(state)
+    t0 = time.perf_counter()
+    store.mirror_now(man1.ckpt_id)
+    mirror_s = time.perf_counter() - t0
+
+    # step 2 touches 1/8 of the layers: the re-mirror uploads only the
+    # changed chunks, content addressing dedups the rest
+    keys = sorted(state)
+    for k in keys[: max(1, len(keys) // 8)]:
+        state[k]["w"] += 0.25
+    man2 = ckpt.save_checkpoint(store, state, step=2)
+    c2 = store.mirror_now(man2.ckpt_id)
+    moved = c2["upload_bytes"] + c2["dedup_bytes"]
+
+    # evict the local pool: restores now read through the remote tier
+    store.evict_local(man1.ckpt_id)
+    store.evict_local(man2.ckpt_id)
+    t0 = time.perf_counter()
+    tree = ckpt.restore_tree(store, man2.ckpt_id)
+    par_s = time.perf_counter() - t0
+    rmb = _mb(tree)
+    # the read-through fetch cached the chunks back locally; drop them
+    # again and repeat single-threaded
+    store.evict_local(man2.ckpt_id)
+    serial = _attach(1)
+    t0 = time.perf_counter()
+    ckpt.restore_tree(serial, man2.ckpt_id)
+    ser_s = time.perf_counter() - t0
+    store.close()
+    serial.close()
+    return {
+        "tier_latency_ms_per_op": latency_s * 1e3,
+        "tier_io_threads": threads,
+        "tier_mirror_mb_s": round(mb / mirror_s, 1),
+        "tier_mirror_dedup_ratio": round(c2["dedup_bytes"] / moved, 4)
+        if moved else 0.0,
+        "tier_delta_upload_bytes": c2["upload_bytes"],
+        "tier_restore_parallel_mb_s": round(rmb / par_s, 1),
+        "tier_restore_serial_mb_s": round(rmb / ser_s, 1),
+        "tier_parallel_speedup": round(ser_s / par_s, 2),
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default="")
     parser.add_argument("--leaves", type=int, default=16)
     parser.add_argument("--leaf-elems", type=int, default=1 << 17)
     parser.add_argument("--steps", type=int, default=4)
+    parser.add_argument("--tier", action="store_true",
+                        help="add the storage-tier plane benchmarks")
+    parser.add_argument("--tier-threads", type=int, default=8)
     args = parser.parse_args(argv)
 
     root = tempfile.mkdtemp(prefix="bench_ckpt_")
@@ -172,6 +248,9 @@ def main(argv=None):
                                steps=args.steps))
         out.update(bench_dedup(root, _state(args.leaves, args.leaf_elems)))
         out.update(bench_restore(root, _state(args.leaves, args.leaf_elems)))
+        if args.tier:
+            out.update(bench_tier(root, _state(args.leaves, args.leaf_elems),
+                                  threads=args.tier_threads))
     finally:
         shutil.rmtree(root, ignore_errors=True)
     print(json.dumps(out, indent=2))

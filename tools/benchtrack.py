@@ -54,6 +54,10 @@ class MetricSpec:
     direction: str
     rel_threshold: float = 0.10
     bar: Optional[float] = None
+    # absolute FLOOR for higher-better metrics, the dual of ``bar``: the
+    # value must stay at or above it regardless of history (e.g. the
+    # tiered-restore ">= 2x parallel speedup" acceptance)
+    floor: Optional[float] = None
 
 
 # explicit specs for the flat-dict families; PIPE metric names are
@@ -91,6 +95,20 @@ METRIC_SPECS: Dict[str, MetricSpec] = {
                                     bar=OBS_OVERHEAD_BAR_PCT),
     "train_step_goodput_delta_pct": MetricSpec("lower", 3.0,
                                                bar=OBS_OVERHEAD_BAR_PCT),
+    # CKPT (checkpoint plane + storage tier; tools/bench_ckpt.py --tier).
+    # Generous relative thresholds — tmpfs/CI microbenchmarks — but a
+    # hard absolute floor on the parallel-restore speedup: the tier's
+    # reason to exist is that restore-from-remote is not serial
+    "blocking_save_ms": MetricSpec("lower", 0.50),
+    "async_pause_ms": MetricSpec("lower", 0.50),
+    "dedup_ratio": MetricSpec("higher", 0.10),
+    "restore_mb_s": MetricSpec("higher", 0.30),
+    "shard_restore_mb_s": MetricSpec("higher", 0.30),
+    "tier_mirror_mb_s": MetricSpec("higher", 0.30),
+    "tier_mirror_dedup_ratio": MetricSpec("higher", 0.10),
+    "tier_restore_parallel_mb_s": MetricSpec("higher", 0.30),
+    "tier_restore_serial_mb_s": MetricSpec("higher", 0.30),
+    "tier_parallel_speedup": MetricSpec("higher", 0.20, floor=2.0),
 }
 
 # suffix -> spec rules for PIPE-style generated metric names
@@ -167,6 +185,7 @@ FAMILIES = {
     "SERVE": _extract_flat,
     "PIPE": _extract_pipe,
     "OBS": _extract_flat,
+    "CKPT": _extract_flat,
 }
 
 _ROUND_RE = re.compile(r"^([A-Z_]+?)_r(\d+)\.json$")
@@ -219,6 +238,11 @@ def check(root: str = REPO_ROOT) -> Tuple[List[str], List[str]]:
                 failures.append(
                     f"{where}: {value:g} over the absolute bar "
                     f"{spec.bar:g}")
+                continue
+            if spec.floor is not None and value < spec.floor:
+                failures.append(
+                    f"{where}: {value:g} under the absolute floor "
+                    f"{spec.floor:g}")
                 continue
             base = (prev or {}).get("metrics", {}).get(metric) \
                 if prev else None

@@ -782,6 +782,36 @@ _CKP_WRITE_ATTRS = ("write_text", "write_bytes")
 _CKP_DUMP_CALLS = {"json.dump", "pickle.dump", "cloudpickle.dump",
                    "numpy.save", "np.save"}
 
+# Storage-backend write chokepoints (ckpt/tier): a ChunkBackend or bucket
+# client OWNS its tier's durability discipline, so its designated write
+# methods may open files directly — PROVIDED the method itself upholds the
+# temp+fsync+rename contract. Checked structurally: the method must call
+# both ``os.fsync`` and ``os.replace``; a backend write method that opens
+# a file without them still flags.
+_CKP_BACKEND_CLASS_SUFFIXES = ("Backend", "BucketClient")
+_CKP_BACKEND_WRITE_METHODS = {"put", "put_object", "put_manifest",
+                              "upload_part", "complete_multipart"}
+
+
+def _ckp_backend_exempt_calls(module: Module) -> set:
+    """ids of Call nodes inside a storage-backend write method that
+    provably renames a fsynced temp file into place."""
+    exempt: set = set()
+    for cls in ast.walk(module.tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name.endswith(_CKP_BACKEND_CLASS_SUFFIXES)):
+            continue
+        for fn in cls.body:
+            if not (isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and fn.name in _CKP_BACKEND_WRITE_METHODS):
+                continue
+            dotted = {module.resolver.dotted(n.func)
+                      for n in ast.walk(fn) if isinstance(n, ast.Call)}
+            if {"os.fsync", "os.replace"} <= dotted:
+                exempt.update(id(n) for n in ast.walk(fn)
+                              if isinstance(n, ast.Call))
+    return exempt
+
 
 def _open_write_mode(call: ast.Call) -> bool:
     """True if this ``open(...)`` call names a write/append/create mode.
@@ -811,9 +841,10 @@ class CheckpointWriteOutsideHelper(Rule):
         if not (module.path.startswith(_CKP_PATH_PREFIXES)
                 or module.path in _CKP_PATH_FILES):
             return iter(())
+        exempt = _ckp_backend_exempt_calls(module)
         findings = []
         for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call):
+            if not isinstance(node, ast.Call) or id(node) in exempt:
                 continue
             dotted = module.resolver.dotted(node.func)
             if dotted in ("open", "io.open", "builtins.open"):
