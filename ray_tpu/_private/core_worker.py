@@ -2689,7 +2689,7 @@ class CoreWorker:
         if box is None:
             box = self._chan_mail[name] = {
                 "q": _deque(), "data": asyncio.Event(),
-                "space": asyncio.Event(), "cap": 2}
+                "space": asyncio.Event(), "cap": 2, "last_seq": -1}
         return box
 
     def chan_pop(self, name: str, timeout: float = 300.0) -> bytes:
@@ -3017,6 +3017,12 @@ class CoreWorker:
                 # a mailbox nothing will ever pop again
                 return wire.dumps({"status": "closed"})
             box = self._chan_mailbox(req["name"])
+            seq = req.get("seq")
+            if seq is not None and seq <= box["last_seq"]:
+                # idempotent retry: the writer re-pushes after an ambiguous
+                # RPC failure; a sequence it already delivered is acked
+                # without enqueueing (never double-delivers)
+                return wire.dumps({"status": "ok", "dup": True})
             deadline = time.monotonic() + 300.0
             while len(box["q"]) >= box["cap"]:
                 if time.monotonic() > deadline or self._shutdown \
@@ -3026,6 +3032,12 @@ class CoreWorker:
                     await asyncio.wait_for(box["space"].wait(), 5.0)
                 except asyncio.TimeoutError:
                     pass
+            if seq is not None:
+                if seq <= box["last_seq"]:
+                    # re-check after parking: a timed-out original and its
+                    # retry can park concurrently on a full mailbox
+                    return wire.dumps({"status": "ok", "dup": True})
+                box["last_seq"] = seq
             box["q"].append(req["blob"])
             ev, box["data"] = box["data"], asyncio.Event()
             ev.set()

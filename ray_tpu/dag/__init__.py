@@ -5,11 +5,17 @@ lazy graph (dag_node.py, class_node.py, input_node.py); ``execute`` walks it;
 ``experimental_compile`` (dag_node.py:279) returns a ``CompiledDAG`` with a
 precomputed topological schedule.
 
-Round-1 scope note: the compiled path pre-resolves the schedule and reuses
-pickled task payloads, but still rides the normal actor-call RPC plane; the
-shared-memory mutable-object channel data plane (reference:
-experimental/channel/shared_memory_channel.py + the seqlock C++ side) is the
-next tier of this module (see channels.py for the channel primitives).
+Scope note: the compiled path pre-resolves the schedule and reuses
+pickled task payloads, but still rides the normal actor-call RPC plane;
+the shared-memory mutable-object channel data plane (reference:
+experimental/channel/shared_memory_channel.py + the seqlock C++ side)
+lives in channels.py — seqlock slot RINGS (depth >= 2) with per-reader
+acks, zero-copy array framing (tree-skeleton header, leaf buffers
+memcpy'd into the slot, no pickle on the hot path), optional quantized
+activation streaming, and a seq-deduped cross-host mailbox writer. The
+pipeline plane (ray_tpu/train/pipeline) is its primary consumer; wiring
+the compiled DAG executor itself over these channels is the remaining
+tier of this module.
 """
 
 from __future__ import annotations

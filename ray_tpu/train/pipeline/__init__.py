@@ -18,10 +18,12 @@ Public surface::
 # schedule/partition geometry must stay importable anywhere (raylint,
 # benches, the schedule golden tests) without that weight.
 _EXPORTS = {
-    "Op": "schedule", "build_schedule": "schedule", "simulate": "schedule",
+    "Op": "schedule", "build_schedule": "schedule",
+    "build_interleaved_schedule": "schedule", "simulate": "schedule",
     "bubble_upper_bound": "schedule",
     "max_inflight_activations": "schedule",
     "partition_layers": "partition", "stage_param_keys": "partition",
+    "rank_chunk_keys": "partition",
     "split_params": "partition", "merge_params": "partition",
     "StagePrograms": "partition", "make_stage_optimizer": "partition",
     "PipelineStage": "stage",

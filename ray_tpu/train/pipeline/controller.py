@@ -31,7 +31,7 @@ from ray_tpu.models.transformer import TransformerConfig
 from ray_tpu.train.pipeline import schedule as sched
 from ray_tpu.train.pipeline.partition import (
     partition_layers,
-    split_params,
+    rank_chunk_keys,
     stage_param_keys,
 )
 from ray_tpu.train.pipeline.stage import PipelineStage, channel_shm_paths
@@ -48,6 +48,18 @@ class PipelineConfig:
     clip_global_norm: Optional[float] = 1.0
     ckpt_every: int = 0  # steps between per-stage checkpoints (0 = off)
     channel_capacity: int = 4 << 20
+    # interleaved virtual stages (Megatron-style): each rank hosts this
+    # many non-contiguous model chunks; bubble shrinks to
+    # (S-1)/(S-1+V*M). V>1 requires num_microbatches % num_stages == 0.
+    virtual_stages: int = 1
+    # slots per channel edge: depth>=2 lets SEND_F overlap the next
+    # compute op instead of blocking on the downstream ack
+    channel_depth: int = 2
+    # quantized activation streaming over the forward channels (None /
+    # "int8" / "fp8" / "bf16" / "int8:128"-style spec). Gradients and
+    # non-float leaves always stream exact; None is bitwise-identical to
+    # the uncompressed path.
+    activation_compression: Optional[str] = None
     step_timeout_s: float = 120.0
     max_recoveries: int = 3
     boundaries: Optional[List] = None  # explicit [start, stop) per stage
@@ -111,10 +123,13 @@ class PipelineTrainer:
         self._cfg_blob = cloudpickle.dumps(cfg)
         self._opt_blob = (cloudpickle.dumps(optimizer_factory)
                           if optimizer_factory else None)
+        # with interleaving the partition cut is at virtual-stage (chunk)
+        # granularity: P = S*V boundary ranges, chunk q living on rank q%S
+        self.num_virtual = pipe.num_stages * pipe.virtual_stages
         self._bounds = (pipe.boundaries
-                        or partition_layers(cfg.n_layers, pipe.num_stages))
-        self._schedule = sched.build_schedule(pipe.num_stages,
-                                              pipe.num_microbatches)
+                        or partition_layers(cfg.n_layers, self.num_virtual))
+        self._schedule = sched.build_interleaved_schedule(
+            pipe.num_stages, pipe.num_microbatches, pipe.virtual_stages)
         self.actors: List[Any] = []
         self._seed_weight_plane(params, seed)
         self._form_gang(restore=False)
@@ -150,9 +165,15 @@ class PipelineTrainer:
             params = nn.unbox(params)
         self.init_params = params
         self._stores = []
-        for s, sub in enumerate(split_params(params, self.cfg,
-                                             self.pipe.num_stages,
-                                             self._bounds)):
+        # cut at chunk granularity, publish per RANK (a rank's store holds
+        # the merge of its chunks' disjoint key sets; the stage re-splits)
+        S = self.pipe.num_stages
+        for s in range(S):
+            sub = {k: params[k]
+                   for keys in rank_chunk_keys(
+                       self.cfg, s, S, self.pipe.virtual_stages,
+                       self._bounds).values()
+                   for k in keys}
             store = WeightStore(self._stage_store_name(s))
             store.publish({"params": sub}, durable=True)
             self._stores.append(store)
@@ -178,7 +199,10 @@ class PipelineTrainer:
                 self.run_name, self.generation,
                 channel_capacity=pipe.channel_capacity,
                 boundaries=[list(b) for b in self._bounds],
-                bucket_bytes=pipe.bucket_bytes)
+                bucket_bytes=pipe.bucket_bytes,
+                num_chunks=pipe.virtual_stages,
+                channel_depth=pipe.channel_depth,
+                activation_compression=pipe.activation_compression)
             for s in range(pipe.num_stages)
         ]
         ray_tpu.get([a.ready.remote() for a in self.actors], timeout=120)
@@ -221,7 +245,8 @@ class PipelineTrainer:
         # a dead writer cannot unlink its shm slots; reclaim them here so
         # generations never accumulate segments
         for path in channel_shm_paths(self.run_name, self.generation,
-                                      self.pipe.num_stages):
+                                      self.pipe.num_stages,
+                                      self.pipe.virtual_stages):
             try:
                 os.unlink(path)
             except OSError:
@@ -289,12 +314,15 @@ class PipelineTrainer:
                        60.0)
         last = results[-1]
         coef = self.cfg.moe_aux_coef
-        # the last stage's loss already includes ITS aux term; fold in the
-        # upstream stages' aux so the reported loss matches the single-mesh
-        # objective
+        # the final virtual stage's loss already includes ITS aux term;
+        # fold in every other chunk's aux so the reported loss matches the
+        # single-mesh objective. Keyed by microbatch (aux_by_mb) — with
+        # interleaving a rank's aux arrives in virtual-microbatch order,
+        # not microbatch order.
         upstream_aux = float(np.mean([
-            sum(r["aux"][i] for r in results[:-1])
-            for i in range(pipe.num_microbatches)])) if S > 1 else 0.0
+            sum(r["aux_by_mb"].get(i, 0.0) for r in results)
+            for i in range(pipe.num_microbatches)])) \
+            if self.num_virtual > 1 else 0.0
         loss = float(np.mean(last["losses"])) + coef * upstream_aux
         stats = {
             "step": self.step,
@@ -305,6 +333,7 @@ class PipelineTrainer:
             "compute_s": [r["compute_s"] for r in results],
             "recv_wait_s": [r["recv_wait_s"] for r in results],
             "send_bytes": [r["send_bytes"] for r in results],
+            "hop": [r["hop"] for r in results],
             "activation_bytes_per_mb": (
                 results[0]["send_bytes"] // pipe.num_microbatches
                 if S > 1 else 0),

@@ -69,6 +69,26 @@ def stage_param_keys(cfg: TransformerConfig, stage: int, num_stages: int,
     return keys
 
 
+def rank_chunk_keys(cfg: TransformerConfig, rank: int, num_stages: int,
+                    num_chunks: int = 1,
+                    boundaries: Optional[List[Tuple[int, int]]] = None
+                    ) -> Dict[int, List[str]]:
+    """Param keys per model chunk for one pipeline rank hosting
+    ``num_chunks`` interleaved chunks (Megatron-style virtual stages).
+
+    Chunk ``v`` on rank ``r`` is virtual stage ``q = v*num_stages + r``
+    of the ``num_stages*num_chunks``-way cut — the interleaved placement
+    is just the deeper cut re-dealt round-robin, so every key helper
+    above applies unchanged at ``P = S*V``. Returns ``{q: [keys...]}``
+    in local chunk order (ascending ``v``); the union across all ranks
+    partitions the full key set."""
+    num_virtual = num_stages * num_chunks
+    return {v * num_stages + rank:
+            stage_param_keys(cfg, v * num_stages + rank, num_virtual,
+                             boundaries)
+            for v in range(num_chunks)}
+
+
 def split_params(full_params: Dict[str, Any], cfg: TransformerConfig,
                  num_stages: int,
                  boundaries: Optional[List[Tuple[int, int]]] = None

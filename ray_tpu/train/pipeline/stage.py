@@ -1,35 +1,44 @@
 """PipelineStage: one actor gang member executing a 1F1B op stream.
 
-Each stage is an actor owning one partition of the model (see
-partition.py), its own optimizer state, and the channel endpoints to its
-neighbors. Microbatch activations flow stage->stage over the compiled-graph
-channel plane (``dag/channels.py``): the shm seqlock slot on one node, the
-worker-mailbox push channel across nodes — writer-creates, reader-attaches,
-and the depth-1 reader-ack backpressure is exactly the rendezvous the 1F1B
-schedule needs (a stage can run at most one send ahead of its consumer).
+Each stage is an actor owning one or more chunks of the model (see
+partition.py; with interleaved schedules a rank hosts ``V`` non-contiguous
+chunks — virtual stage ``q = chunk*S + rank``), per-chunk optimizer state,
+and the channel endpoints to its neighbor ranks. Microbatch activations
+flow rank->rank over the compiled-graph channel plane (``dag/channels.py``):
+a ring of shm seqlock slots on one node, the worker-mailbox push channel
+across nodes — writer-creates, reader-attaches, and the depth-``d``
+reader-ack backpressure keeps the no-drop rendezvous the 1F1B schedule
+needs while letting a SEND overlap the next compute op. With ``V`` chunks
+the physical topology is a ring: rank ``r`` writes ``f<r>`` read by rank
+``(r+1)%S`` (the wrap edge carries chunk transitions) and reads ``b<r>``
+written by rank ``(r+1)%S``; every hop is FIFO on its edge, and the
+schedule emits sends and recvs in matching order (simulate() asserts it).
 
 Observability: every op lands as a built-in span — ``pipe.fwd`` /
 ``pipe.bwd`` bound the compute, ``pipe.send`` / ``pipe.recv`` bound the
-channel hops, all tagged ``stage``/``mb``/``step``. The sender ships its
-span context in the payload and the receiver parents ``pipe.recv`` onto
-it, so ``/api/timeline`` renders every microbatch hand-off as a matched
+channel hops, all tagged ``stage``/``mb``/``chunk``/``step`` plus the
+channel's per-hop breakdown (encode/copy/ack-wait on the send side,
+copy/decode on the recv side). The sender ships its span context in the
+payload and the receiver parents ``pipe.recv`` onto it, so
+``/api/timeline`` renders every microbatch hand-off as a matched
 cross-process flow arrow (PR 10's span plumbing, no pipeline-specific
-timeline code).
+timeline code). Aggregated channel time also lands on the
+``ray_tpu.pipe.*`` metric instruments once per schedule run.
 
 Failure model: stages are stateless between steps modulo (params,
-opt_state, step), which the controller checkpoints per stage through the
-ckpt plane. A dead stage kills the step (channel reads time out / actor
-death surfaces on the controller's ray.get); recovery re-forms the whole
-gang at a fresh channel generation and restores every stage from its
-manifest — mid-schedule partial work is discarded by construction (grads
-only apply at the step boundary).
+opt_state, step) per chunk, which the controller checkpoints per stage
+through the ckpt plane. A dead stage kills the step (channel reads time
+out / actor death surfaces on the controller's ray.get); recovery re-forms
+the whole gang at a fresh channel generation and restores every stage from
+its manifest — mid-schedule partial work is discarded by construction
+(grads only apply at the step boundary).
 """
 
 from __future__ import annotations
 
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -37,31 +46,91 @@ import ray_tpu
 from ray_tpu.train.pipeline import schedule as sched
 
 
-def _chan_names(run: str, generation: int, stage: int, num_stages: int
-                ) -> Dict[str, Optional[str]]:
-    """Channel names for this stage's four possible endpoints. ``f<s>``
-    carries stage s -> s+1 activations, ``b<s>`` carries s+1 -> s
-    gradients; the writer side creates the slot."""
+def _chan_names(run: str, generation: int, stage: int, num_stages: int,
+                num_chunks: int = 1) -> Dict[str, Optional[str]]:
+    """Channel names for this rank's four possible endpoints. ``f<r>``
+    carries rank r -> (r+1)%S activations, ``b<r>`` carries (r+1)%S -> r
+    gradients; the writer side creates the ring. With one chunk per rank
+    the wrap edges don't exist (plain chain); with V>1 every edge exists
+    (chunk transitions ride the wrap). S==1 needs no channels at all (the
+    executor hands chunks off in-process)."""
     g = f"{run}.g{generation}"
+    S = num_stages
+    if S == 1:
+        return {"fwd_out": None, "bwd_out": None,
+                "fwd_in": None, "bwd_in": None}
+    if num_chunks == 1:
+        return {
+            "fwd_out": f"{g}.f{stage}" if stage < S - 1 else None,
+            "bwd_out": f"{g}.b{stage - 1}" if stage > 0 else None,
+            "fwd_in": f"{g}.f{stage - 1}" if stage > 0 else None,
+            "bwd_in": f"{g}.b{stage}" if stage < S - 1 else None,
+        }
     return {
-        "fwd_out": f"{g}.f{stage}" if stage < num_stages - 1 else None,
-        "bwd_out": f"{g}.b{stage - 1}" if stage > 0 else None,
-        "fwd_in": f"{g}.f{stage - 1}" if stage > 0 else None,
-        "bwd_in": f"{g}.b{stage}" if stage < num_stages - 1 else None,
+        "fwd_out": f"{g}.f{stage}",
+        "bwd_out": f"{g}.b{(stage - 1) % S}",
+        "fwd_in": f"{g}.f{(stage - 1) % S}",
+        "bwd_in": f"{g}.b{stage}",
     }
 
 
-def channel_shm_paths(run: str, generation: int, num_stages: int
-                      ) -> List[str]:
+def channel_shm_paths(run: str, generation: int, num_stages: int,
+                      num_chunks: int = 1) -> List[str]:
     """The /dev/shm paths a same-node gang's channels occupy (the
     controller unlinks them after killing a gang — a dead writer cannot)."""
     out = []
     for s in range(num_stages):
-        names = _chan_names(run, generation, s, num_stages)
+        names = _chan_names(run, generation, s, num_stages, num_chunks)
         for key in ("fwd_out", "bwd_out"):
             if names[key]:
-                out.append(f"/dev/shm/rtpu_chan_{names[key]}")
+                path = f"/dev/shm/rtpu_chan_{names[key]}"
+                if path not in out:
+                    out.append(path)
     return out
+
+
+_PIPE_METRICS = None
+
+
+def _pipe_metrics():
+    """Lazy ``ray_tpu.pipe.*`` channel-plane instruments (one registration
+    per process; recorded once per schedule run, not per hop)."""
+    global _PIPE_METRICS
+    if _PIPE_METRICS is None:
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        _PIPE_METRICS = {
+            "send_seconds": Histogram(
+                "ray_tpu.pipe.send_seconds",
+                description="Per-step channel send wall time on one rank "
+                            "(encode + copy + downstream ack wait).",
+                boundaries=[0.001, 0.01, 0.1, 1.0, 10.0],
+                tag_keys=("stage",)),
+            "recv_wait_seconds": Histogram(
+                "ray_tpu.pipe.recv_wait_seconds",
+                description="Per-step time a rank spent blocked on "
+                            "upstream/downstream activations (the realized "
+                            "pipeline bubble).",
+                boundaries=[0.001, 0.01, 0.1, 1.0, 10.0],
+                tag_keys=("stage",)),
+            "wire_bytes": Counter(
+                "ray_tpu.pipe.wire_bytes",
+                description="Bytes written to activation channels (post-"
+                            "compression framing, headers included).",
+                tag_keys=("stage",)),
+            "encode_seconds": Histogram(
+                "ray_tpu.pipe.encode_seconds",
+                description="Per-step activation framing cost on one rank "
+                            "(leaf extraction + optional quantization + "
+                            "skeleton pickle).",
+                boundaries=[0.0001, 0.001, 0.01, 0.1, 1.0],
+                tag_keys=("stage",)),
+        }
+    return _PIPE_METRICS
+
+
+_HOP_SEND_KEYS = ("encode_s", "pickle_s", "copy_s", "ack_wait_s")
+_HOP_RECV_KEYS = ("copy_s", "decode_s")
 
 
 @ray_tpu.remote
@@ -71,19 +140,27 @@ class PipelineStage:
                  channel_capacity: int = 4 << 20,
                  boundaries: Optional[list] = None,
                  bucket_bytes: Optional[int] = None,
-                 dp_group: Optional[Dict[str, Any]] = None):
+                 dp_group: Optional[Dict[str, Any]] = None,
+                 num_chunks: int = 1, channel_depth: int = 2,
+                 activation_compression: Optional[str] = None):
         # driver-authored blobs: decode only through the audited
         # serialization boundary (raylint SER001)
         from ray_tpu._private.serialization import loads_trusted
 
         self.stage = stage
         self.num_stages = num_stages
+        self.num_chunks = num_chunks
+        # global virtual-stage ids this rank hosts, local chunk order
+        self.chunks = [v * num_stages + stage for v in range(num_chunks)]
+        self.num_virtual = num_stages * num_chunks
         self.cfg = loads_trusted(cfg_blob)
         self._opt_factory = (loads_trusted(opt_blob) if opt_blob
                              else None)
         self.run_name = run_name
         self.generation = generation
         self.channel_capacity = channel_capacity
+        self.channel_depth = channel_depth
+        self.activation_compression = activation_compression
         self.boundaries = ([tuple(b) for b in boundaries]
                            if boundaries else None)
         # bucketed optimizer apply (None = whole-tree apply, the
@@ -96,6 +173,11 @@ class PipelineStage:
         # coordination round-trip. Bucket-wise apply is bit-identical to
         # whole-tree apply for per-leaf transforms (adam family).
         self.dp_group = dict(dp_group) if dp_group else None
+        if num_chunks > 1 and (bucket_bytes or dp_group):
+            raise ValueError(
+                "interleaved stages (num_chunks > 1) do not compose with "
+                "bucket_bytes/dp_group yet — the bucket plan is keyed on a "
+                "single param tree per rank")
         if self.dp_group is not None and not bucket_bytes:
             # the replica allreduce rides the bucket plan — a dp group
             # without an explicit bound gets the default bucket size
@@ -106,14 +188,24 @@ class PipelineStage:
         self._bucket_plan = None
         self._reducer = None
         self._pending_reduce: Optional[List[Any]] = None
-        self.programs = None
-        self.params = None
-        self.opt_state = None
+        self.programs: Optional[Dict[int, Any]] = None  # chunk id -> programs
+        self.params: Optional[Dict[int, Any]] = None    # chunk id -> tree
+        self.opt_state: Optional[Dict[int, Any]] = None
         self.step = 0
         self._chans: Dict[str, Any] = {}
-        self._acc = None  # accumulated grads across a step's microbatches
-        self._inputs: Dict[int, Any] = {}  # mb -> stashed fwd input
+        self._acc: Dict[int, Any] = {}  # chunk id -> accumulated grads
+        self._inputs: Dict[Tuple[int, int], Any] = {}  # (chunk, mb) -> input
+        self._ibuf_f: Dict[Tuple[int, int], Any] = {}  # S==1 in-proc handoff
+        self._ibuf_b: Dict[Tuple[int, int], Any] = {}
         self._last_losses: List[float] = []
+
+    # -- single-chunk compatibility accessors -----------------------------
+
+    def _q0(self) -> int:
+        return self.chunks[0]
+
+    def _p0(self):
+        return self.params[self._q0()]
 
     # -- gang formation -------------------------------------------------
 
@@ -121,27 +213,31 @@ class PipelineStage:
         return True
 
     def create_channels(self) -> bool:
-        """Writer side: create this stage's outgoing slots. Runs on every
-        stage BEFORE any reader attaches."""
+        """Writer side: create this rank's outgoing rings. Runs on every
+        stage BEFORE any reader attaches. The forward ring optionally
+        streams quantized (``activation_compression``); gradients stay
+        exact."""
         from ray_tpu.dag.channels import Channel
 
         names = _chan_names(self.run_name, self.generation, self.stage,
-                            self.num_stages)
+                            self.num_stages, self.num_chunks)
         for key in ("fwd_out", "bwd_out"):
             if names[key] is not None:
                 self._chans[key] = Channel(
                     names[key], capacity=self.channel_capacity,
-                    create=True, num_readers=1)
+                    create=True, num_readers=1, depth=self.channel_depth)
+        if self.activation_compression and "fwd_out" in self._chans:
+            self._chans["fwd_out"].set_codec(self.activation_compression)
         return True
 
     def open_channels(self, timeout: float = 30.0) -> bool:
-        """Reader side: attach to the neighbors' slots (they were created
+        """Reader side: attach to the neighbors' rings (they were created
         by create_channels on every stage first; the retry only covers
         filesystem visibility)."""
         from ray_tpu.dag.channels import Channel
 
         names = _chan_names(self.run_name, self.generation, self.stage,
-                            self.num_stages)
+                            self.num_stages, self.num_chunks)
         deadline = time.monotonic() + timeout
         for key in ("fwd_in", "bwd_in"):
             if names[key] is None:
@@ -163,19 +259,22 @@ class PipelineStage:
 
             opt = (self._opt_factory() if self._opt_factory
                    else make_stage_optimizer())
-            self.programs = StagePrograms(
-                self.cfg, self.stage, self.num_stages, opt,
-                boundaries=self.boundaries)
+            self.programs = {
+                q: StagePrograms(self.cfg, q, self.num_virtual, opt,
+                                 boundaries=self.boundaries)
+                for q in self.chunks
+            }
 
     def _bucketing(self):
         """Build (lazily, params must exist) the bucket plan, per-bucket
-        param path sets, and — with a dp group — the async reducer."""
+        param path sets, and — with a dp group — the async reducer.
+        Single-chunk ranks only (guarded at construction)."""
         if self._bucket_plan is None and self.bucket_bytes:
             from ray_tpu.collective.bucketed import (AsyncBucketReducer,
                                                      leaf_meta, plan_buckets)
 
             self._bucket_plan = plan_buckets(
-                leaf_meta(self.params), bucket_bytes=self.bucket_bytes,
+                leaf_meta(self._p0()), bucket_bytes=self.bucket_bytes,
                 world_size=(self.dp_group or {}).get("world_size", 1))
             if self.dp_group is not None:
                 from ray_tpu import collective as col
@@ -188,22 +287,22 @@ class PipelineStage:
                 self._reducer = AsyncBucketReducer(name, self._bucket_plan)
         return self._bucket_plan
 
-    def _init_opt_state(self):
+    def _init_opt_state(self, q: int):
         """Whole-tree state, or one optimizer state per bucket (keyed by
         bucket index as str so ckpt manifests serialize it plainly)."""
         if self.bucket_bytes:
             self._bucketing()
             return {
-                str(b.index): self.programs.opt_init(
+                str(b.index): self.programs[q].opt_init(
                     self._subtree(b.paths))
                 for b in self._bucket_plan.buckets
             }
-        return self.programs.opt_init(self.params)
+        return self.programs[q].opt_init(self.params[q])
 
     def _flat_params(self) -> Dict[str, Any]:
         import jax
 
-        flat, _ = jax.tree_util.tree_flatten_with_path(self.params)
+        flat, _ = jax.tree_util.tree_flatten_with_path(self._p0())
         return {jax.tree_util.keystr(k): v for k, v in flat}
 
     def _subtree(self, paths) -> Dict[str, Any]:
@@ -212,59 +311,86 @@ class PipelineStage:
 
     def init_weights(self, store_name: str,
                      version: Optional[int] = None) -> int:
-        """Pull this stage's parameter subtree from its weight-plane store
-        (the per-stage weight placement path) and init fresh optimizer
-        state for it."""
+        """Pull this rank's parameter subtree from its weight-plane store
+        (the per-stage weight placement path), cut it into this rank's
+        chunks, and init fresh optimizer state for each."""
+        from ray_tpu.train.pipeline.partition import rank_chunk_keys
         from ray_tpu.weights import WeightStore
 
         self._build_programs()
         tree, version = WeightStore(store_name).pull(version,
                                                      return_version=True)
-        self.params = tree["params"]
-        self.opt_state = self._init_opt_state()
+        merged = tree["params"]
+        self.params = {
+            q: {k: merged[k] for k in keys}
+            for q, keys in rank_chunk_keys(
+                self.cfg, self.stage, self.num_stages, self.num_chunks,
+                self.boundaries).items()
+        }
+        self.opt_state = {q: self._init_opt_state(q) for q in self.chunks}
         self.step = 0
         return version
 
     # -- schedule execution ---------------------------------------------
 
-    def _send(self, key: str, mb: int, payload, step: int, nbytes: int):
+    def _send(self, key: str, chunk: int, mb: int, payload, step: int,
+              nbytes: int, hop: Dict[str, float]):
         from ray_tpu.util import tracing
 
         ctx = tracing.current_context()
         span_id = tracing.new_span_id()
         trace_id = ctx[0] if ctx else tracing.new_trace_id()
         t0 = time.time()
-        self._chans[key].write({"mb": mb, "data": payload,
-                                "trace": (trace_id, span_id)})
+        chan = self._chans[key]
+        chan.write({"mb": mb, "chunk": chunk, "data": payload,
+                    "trace": (trace_id, span_id)})
+        st = chan.last_write_stats
+        for k in _HOP_SEND_KEYS:
+            hop["send_" + k] += st.get(k, 0.0)
+        hop["send_wire_bytes"] += st.get("wire_bytes", 0)
+        hop["send_skel_bytes"] += st.get("skel_bytes", 0)
         tracing.record_span(
             "pipe.send", t0, time.time(), category="pipe",
             trace_id=trace_id, span_id=span_id,
             parent_id=ctx[1] if ctx else None,
-            stage=self.stage, mb=mb, step=step, nbytes=nbytes)
+            stage=self.stage, mb=mb, chunk=chunk, step=step, nbytes=nbytes,
+            wire_bytes=st.get("wire_bytes", 0),
+            encode_s=st.get("encode_s", 0.0), copy_s=st.get("copy_s", 0.0),
+            ack_wait_s=st.get("ack_wait_s", 0.0))
 
-    def _recv(self, key: str, mb: int, step: int):
+    def _recv(self, key: str, chunk: int, mb: int, step: int,
+              hop: Dict[str, float]):
         from ray_tpu.util import tracing
 
         t0 = time.time()
-        msg = self._chans[key].read()
-        if msg["mb"] != mb:
+        chan = self._chans[key]
+        msg = chan.read()
+        if msg["mb"] != mb or msg.get("chunk", 0) != chunk:
             raise RuntimeError(
-                f"stage {self.stage} expected microbatch {mb} on {key}, "
-                f"got {msg['mb']} — schedule/channel desync")
+                f"stage {self.stage} expected (chunk {chunk}, microbatch "
+                f"{mb}) on {key}, got (chunk {msg.get('chunk')}, mb "
+                f"{msg['mb']}) — schedule/channel desync")
+        st = chan.last_read_stats
+        for k in _HOP_RECV_KEYS:
+            hop["recv_" + k] += st.get(k, 0.0)
+        hop["recv_wire_bytes"] += st.get("wire_bytes", 0)
         tr = msg.get("trace")
         tracing.record_span(
             "pipe.recv", t0, time.time(), category="pipe",
             trace_id=tr[0] if tr else tracing.new_trace_id(),
             span_id=tracing.new_span_id(),
             parent_id=tr[1] if tr else None,
-            stage=self.stage, mb=mb, step=step)
+            stage=self.stage, mb=mb, chunk=chunk, step=step,
+            copy_s=st.get("copy_s", 0.0), decode_s=st.get("decode_s", 0.0))
         return msg["data"]
 
     def run_schedule(self, step: int, ops: List, microbatches: Optional[List[dict]] = None) -> Dict[str, Any]:
-        """Execute one step's op stream (``schedule.build_schedule`` row
-        for this stage). ``microbatches`` carries the per-microbatch
-        host data this stage consumes: ``tokens`` on the first stage,
-        ``targets``/``mask`` on the last."""
+        """Execute one step's op stream (``schedule.build_schedule`` /
+        ``build_interleaved_schedule`` row for this rank; op tuples are
+        ``(kind, mb[, chunk])``). ``microbatches`` carries the
+        per-microbatch host data this rank consumes: ``tokens`` on the
+        rank hosting virtual stage 0, ``targets``/``mask`` on the rank
+        hosting the last."""
         from ray_tpu.util import tracing
 
         self._build_programs()
@@ -272,76 +398,109 @@ class PipelineStage:
             raise RuntimeError(
                 f"stage {self.stage}: init_weights/restore before running")
         jax = _jax()
-        p = self.programs
+        S, P = self.num_stages, self.num_virtual
         wall0 = time.perf_counter()
         compute_s = send_s = recv_s = 0.0
         send_bytes = recv_bytes = 0
+        hop = {("send_" + k): 0.0 for k in _HOP_SEND_KEYS}
+        hop.update({("recv_" + k): 0.0 for k in _HOP_RECV_KEYS})
+        hop["send_wire_bytes"] = 0
+        hop["send_skel_bytes"] = 0
+        hop["recv_wire_bytes"] = 0
         losses: List[float] = []
         auxes: List[float] = []
-        self._acc = None
+        aux_by_mb: Dict[int, float] = {}
+        self._acc = {}
         self._inputs.clear()
-        for kind, mb in ops:
+        self._ibuf_f.clear()
+        self._ibuf_b.clear()
+        for op in ops:
+            kind, mb = op[0], op[1]
+            c = op[2] if len(op) > 2 else 0
+            q = c * S + self.stage  # global virtual stage
+            p = self.programs[q]
             if kind == sched.RECV_F:
                 t0 = time.perf_counter()
-                x = self._recv("fwd_in", mb, step)
+                if S == 1:
+                    x = self._ibuf_f.pop((c, mb))
+                else:
+                    x = self._recv("fwd_in", c, mb, step, hop)
                 recv_s += time.perf_counter() - t0
                 recv_bytes += x.nbytes
-                self._inputs[mb] = x
+                self._inputs[(c, mb)] = x
             elif kind == sched.FWD:
-                if self.stage == 0:
-                    self._inputs[mb] = microbatches[mb]["tokens"]
-                x = self._inputs[mb]
+                if p.first:
+                    self._inputs[(c, mb)] = microbatches[mb]["tokens"]
+                x = self._inputs[(c, mb)]
                 t0 = time.perf_counter()
                 with tracing.profile("pipe.fwd", category="pipe",
-                                     stage=self.stage, mb=mb, step=step):
+                                     stage=self.stage, mb=mb, chunk=c,
+                                     step=step):
                     if p.last:
                         # stash only: the BWD value_and_grad computes the
                         # loss — F and B are adjacent here, a separate
                         # forward would double this stage's compute
                         self._y = None
                     else:
-                        y, aux = p.fwd(self.params, x)
+                        y, aux = p.fwd(self.params[q], x)
                         jax.block_until_ready(y)
                         auxes.append(float(aux))
+                        aux_by_mb[mb] = aux_by_mb.get(mb, 0.0) + float(aux)
                         self._y = np.asarray(y)
                 compute_s += time.perf_counter() - t0
             elif kind == sched.SEND_F:
                 t0 = time.perf_counter()
-                self._send("fwd_out", mb, self._y, step, self._y.nbytes)
+                if S == 1:
+                    self._ibuf_f[((q + 1) // S, mb)] = self._y
+                else:
+                    self._send("fwd_out", (q + 1) // S, mb, self._y, step,
+                               self._y.nbytes, hop)
                 send_bytes += self._y.nbytes
                 self._y = None
                 send_s += time.perf_counter() - t0
             elif kind == sched.RECV_B:
                 t0 = time.perf_counter()
-                dy = self._recv("bwd_in", mb, step)
+                if S == 1:
+                    dy = self._ibuf_b.pop((c, mb))
+                else:
+                    dy = self._recv("bwd_in", c, mb, step, hop)
                 recv_s += time.perf_counter() - t0
                 recv_bytes += dy.nbytes
                 self._dy = dy
             elif kind == sched.BWD:
-                x = self._inputs.pop(mb)
+                x = self._inputs.pop((c, mb))
                 t0 = time.perf_counter()
                 with tracing.profile("pipe.bwd", category="pipe",
-                                     stage=self.stage, mb=mb, step=step):
+                                     stage=self.stage, mb=mb, chunk=c,
+                                     step=step):
                     if p.last:
                         loss, aux, dparams, dx = p.bwd(
-                            self.params, x, microbatches[mb]["targets"],
+                            self.params[q], x, microbatches[mb]["targets"],
                             microbatches[mb]["mask"])
                         losses.append(float(loss))
                         auxes.append(float(aux))
+                        # NOT folded into aux_by_mb: the last virtual
+                        # stage's aux is already inside its loss
                     elif p.first:
-                        dparams, dx = p.bwd(self.params, x, self._dy), None
+                        dparams, dx = p.bwd(self.params[q], x,
+                                            self._dy), None
                         self._dy = None
                     else:
-                        dparams, dx = p.bwd(self.params, x, self._dy)
+                        dparams, dx = p.bwd(self.params[q], x, self._dy)
                         self._dy = None
-                    self._acc = (dparams if self._acc is None
-                                 else p.acc_grads(self._acc, dparams))
-                    jax.block_until_ready(self._acc)
+                    acc = self._acc.get(q)
+                    self._acc[q] = (dparams if acc is None
+                                    else p.acc_grads(acc, dparams))
+                    jax.block_until_ready(self._acc[q])
                     self._dx = None if dx is None else np.asarray(dx)
                 compute_s += time.perf_counter() - t0
             elif kind == sched.SEND_B:
                 t0 = time.perf_counter()
-                self._send("bwd_out", mb, self._dx, step, self._dx.nbytes)
+                if S == 1:
+                    self._ibuf_b[((q - 1) // S, mb)] = self._dx
+                else:
+                    self._send("bwd_out", (q - 1) // S, mb, self._dx, step,
+                               self._dx.nbytes, hop)
                 send_bytes += self._dx.nbytes
                 self._dx = None
                 send_s += time.perf_counter() - t0
@@ -349,7 +508,7 @@ class PipelineStage:
                 raise ValueError(f"unknown schedule op {kind!r}")
         self._last_losses = losses
         reduce_launched = False
-        if self.dp_group is not None and self._acc is not None:
+        if self.dp_group is not None and self._acc.get(self._q0()) is not None:
             # kick every bucket's cross-replica allreduce NOW, async: the
             # collectives run while the controller is still collecting
             # results and coordinating the clip across stages
@@ -365,16 +524,26 @@ class PipelineStage:
         goodput.add("bubble", recv_s)
         goodput.add("collective_wait", send_s)
         goodput.count("steps")
+        m = _pipe_metrics()
+        tags = {"stage": str(self.stage)}
+        m["send_seconds"].observe(send_s, tags=tags)
+        m["recv_wait_seconds"].observe(recv_s, tags=tags)
+        m["encode_seconds"].observe(
+            hop["send_encode_s"] + hop["send_pickle_s"], tags=tags)
+        if hop["send_wire_bytes"]:
+            m["wire_bytes"].inc(hop["send_wire_bytes"], tags=tags)
         return {
             "stage": self.stage,
             "losses": losses,
             "aux": auxes,
+            "aux_by_mb": aux_by_mb,
             "wall_s": time.perf_counter() - wall0,
             "compute_s": compute_s,
             "send_s": send_s,
             "recv_wait_s": recv_s,
             "send_bytes": send_bytes,
             "recv_bytes": recv_bytes,
+            "hop": hop,
             "reduce_launched": reduce_launched,
         }
 
@@ -385,7 +554,8 @@ class PipelineStage:
         import jax
 
         self._bucketing()
-        flat, _ = jax.tree_util.tree_flatten_with_path(self._acc)
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            self._acc[self._q0()])
         by_path = {jax.tree_util.keystr(k): np.asarray(v) for k, v in flat}
         self._pending_reduce = [
             self._reducer.submit(b, {p: by_path[p] for p in b.paths})
@@ -401,48 +571,55 @@ class PipelineStage:
 
         from ray_tpu.util import goodput
 
-        flat, treedef = jax.tree_util.tree_flatten_with_path(self._acc)
+        q0 = self._q0()
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self._acc[q0])
         paths = [jax.tree_util.keystr(k) for k, _ in flat]
         reduced: Dict[str, np.ndarray] = {}
         with goodput.region("collective_wait"):
             for handle in self._pending_reduce:
                 reduced.update(handle.result())
         self._pending_reduce = None
-        self._acc = jax.tree_util.tree_unflatten(
+        self._acc[q0] = jax.tree_util.tree_unflatten(
             treedef, [reduced[p] for p in paths])
 
     # -- step boundary ---------------------------------------------------
 
     def grad_sqnorm(self) -> float:
-        if self._acc is None:
+        if not self._acc:
             raise RuntimeError(f"stage {self.stage}: no accumulated grads")
         self._collect_reduced()  # clip must see the cross-replica sum
-        return float(self.programs.grad_sqnorm(self._acc))
+        return float(sum(float(self.programs[q].grad_sqnorm(g))
+                         for q, g in self._acc.items()))
 
     def apply_grads(self, scale: float) -> int:
         """Scale the accumulated grads (1/M and the coordinated global
-        clip, folded into one factor by the controller) and step the
-        optimizer. With ``bucket_bytes`` set the update applies bucket by
-        bucket (per-bucket optimizer state, ``pipe.bucket_apply`` spans) —
-        bit-identical to the whole-tree apply for per-leaf transforms."""
-        if self._acc is None:
+        clip, folded into one factor by the controller) and step each
+        chunk's optimizer. With ``bucket_bytes`` set the update applies
+        bucket by bucket (per-bucket optimizer state, ``pipe.bucket_apply``
+        spans) — bit-identical to the whole-tree apply for per-leaf
+        transforms."""
+        if not self._acc:
             raise RuntimeError(f"stage {self.stage}: no accumulated grads")
         self._collect_reduced()
         if not self.bucket_bytes:
-            self.params, self.opt_state = self.programs.opt_apply(
-                self._acc, scale, self.opt_state, self.params)
-            self._acc = None
+            for q in self.chunks:
+                self.params[q], self.opt_state[q] = \
+                    self.programs[q].opt_apply(self._acc[q], scale,
+                                               self.opt_state[q],
+                                               self.params[q])
+            self._acc = {}
             self.step += 1
             return self.step
         import jax
 
         from ray_tpu.util import tracing
 
+        q0 = self._q0()
         self._bucketing()
-        flat, treedef = jax.tree_util.tree_flatten_with_path(self.params)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.params[q0])
         paths = [jax.tree_util.keystr(k) for k, _ in flat]
         by_path = dict(zip(paths, (v for _, v in flat)))
-        gflat, _ = jax.tree_util.tree_flatten_with_path(self._acc)
+        gflat, _ = jax.tree_util.tree_flatten_with_path(self._acc[q0])
         g_by_path = {jax.tree_util.keystr(k): v for k, v in gflat}
         for b in self._bucket_plan.buckets:
             with tracing.profile("pipe.bucket_apply", category="pipe",
@@ -450,14 +627,14 @@ class PipelineStage:
                                  nbytes=b.nbytes, step=self.step):
                 p_sub = {p: by_path[p] for p in b.paths}
                 g_sub = {p: g_by_path[p] for p in b.paths}
-                new_sub, self.opt_state[str(b.index)] = \
-                    self.programs.opt_apply(g_sub, scale,
-                                            self.opt_state[str(b.index)],
-                                            p_sub)
+                new_sub, self.opt_state[q0][str(b.index)] = \
+                    self.programs[q0].opt_apply(
+                        g_sub, scale, self.opt_state[q0][str(b.index)],
+                        p_sub)
                 by_path.update(new_sub)
-        self.params = jax.tree_util.tree_unflatten(
+        self.params[q0] = jax.tree_util.tree_unflatten(
             treedef, [by_path[p] for p in paths])
-        self._acc = None
+        self._acc = {}
         self.step += 1
         return self.step
 
@@ -467,12 +644,22 @@ class PipelineStage:
         """Per-stage checkpoint through the ckpt plane: one manifest over
         content-addressed chunks per stage, spec-tagged with this stage's
         geometry so a restore onto a different gang shape reshards
-        no-gather (ckpt.restore_shards)."""
+        no-gather (ckpt.restore_shards). Single-chunk ranks keep the
+        pre-interleaving layout (params/opt_state at the top level);
+        multi-chunk ranks nest per virtual stage under ``chunks``."""
         from ray_tpu import ckpt
         from ray_tpu.weights.spec import MeshSpec, ShardedTreeSpec
 
-        tree = {"params": self.params, "opt_state": self.opt_state,
-                "step": np.int64(step)}
+        if self.num_chunks == 1:
+            q0 = self._q0()
+            tree = {"params": self.params[q0],
+                    "opt_state": self.opt_state[q0],
+                    "step": np.int64(step)}
+        else:
+            tree = {"chunks": {str(q): {"params": self.params[q],
+                                        "opt_state": self.opt_state[q]}
+                               for q in self.chunks},
+                    "step": np.int64(step)}
         spec = ShardedTreeSpec.from_tree(
             tree, MeshSpec.host_mesh([f"stage{self.stage}"]))
         store = ckpt.CheckpointStore(
@@ -483,9 +670,9 @@ class PipelineStage:
 
     def restore_ckpt(self, ckpt_root: str,
                      target_step: Optional[int] = None) -> Optional[int]:
-        """Restore (params, opt_state, step) from this stage's latest
-        manifest — or, with ``target_step``, the newest manifest at or
-        below it (the controller's rollback when a crash mid-save left
+        """Restore per-chunk (params, opt_state) + step from this stage's
+        latest manifest — or, with ``target_step``, the newest manifest at
+        or below it (the controller's rollback when a crash mid-save left
         stages disagreeing). None when no usable checkpoint exists (the
         caller falls back to weight-plane init)."""
         from ray_tpu import ckpt
@@ -501,7 +688,25 @@ class PipelineStage:
         if manifest is None:
             return None
         tree = ckpt.restore_tree(store, manifest.ckpt_id)
-        self.params = tree["params"]
+        if "chunks" in tree:
+            saved = set(tree["chunks"])
+            expect = {str(q) for q in self.chunks}
+            if saved != expect:
+                raise RuntimeError(
+                    f"stage {self.stage}: checkpoint holds chunks "
+                    f"{sorted(saved)} but this rank hosts {sorted(expect)} "
+                    f"— restore with the run's original num_chunks")
+            self.params = {q: tree["chunks"][str(q)]["params"]
+                           for q in self.chunks}
+            self.opt_state = {q: tree["chunks"][str(q)]["opt_state"]
+                              for q in self.chunks}
+            self.step = int(tree["step"])
+            return self.step
+        if self.num_chunks > 1:
+            raise RuntimeError(
+                f"stage {self.stage}: checkpoint is single-chunk but this "
+                f"rank hosts {self.num_chunks} chunks — restore with the "
+                f"run's original num_chunks")
         restored = tree["opt_state"]
         # bucketed opt state serializes as {bucket_index_str: state}; a
         # mode/bucket_bytes change between save and restore cannot be
@@ -514,6 +719,8 @@ class PipelineStage:
                 f"{'bucketed' if was_bucketed else 'whole-tree'} but this "
                 f"stage is configured {'bucketed' if self.bucket_bytes else 'whole-tree'} "
                 f"— restore with the run's original bucket_bytes setting")
+        q0 = self._q0()
+        self.params = {q0: tree["params"]}
         if was_bucketed:
             plan = self._bucketing()
             expect = {str(b.index) for b in plan.buckets}
@@ -523,7 +730,7 @@ class PipelineStage:
                     f"{sorted(restored)} but the current plan has "
                     f"{sorted(expect)} — bucket_bytes changed between "
                     f"save and restore")
-        self.opt_state = restored
+        self.opt_state = {q0: restored}
         self.step = int(tree["step"])
         return self.step
 
@@ -533,16 +740,23 @@ class PipelineStage:
         """Cheap content fingerprint for tests (param/opt sums + step)."""
         jax = _jax()
 
-        psum = float(sum(np.asarray(a, dtype=np.float64).sum()
-                         for a in jax.tree.leaves(self.params)))
+        psum = float(sum(
+            np.asarray(a, dtype=np.float64).sum()
+            for q in self.chunks
+            for a in jax.tree.leaves(self.params[q])))
         return {"step": self.step, "param_sum": psum}
 
     def pull_params(self) -> Dict[str, Any]:
-        """This stage's param subtree as host arrays (tests, small
-        models; production consumers go through the weight plane)."""
+        """This rank's param subtree (all chunks merged — top-level keys
+        partition disjointly) as host arrays (tests, small models;
+        production consumers go through the weight plane)."""
         jax = _jax()
 
-        return jax.tree.map(lambda a: np.asarray(a), self.params)
+        out: Dict[str, Any] = {}
+        for q in self.chunks:
+            out.update(jax.tree.map(lambda a: np.asarray(a),
+                                    self.params[q]))
+        return out
 
     def close_channels(self, unlink: bool = False) -> bool:
         for chan in self._chans.values():

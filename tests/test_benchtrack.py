@@ -78,6 +78,46 @@ def test_obs_absolute_bar_fires_without_history(tmp_path):
                for f in failures), failures
 
 
+def test_pipe_analytic_floor_metadata_fires(tmp_path):
+    """A PIPE row carrying ``meta.floor`` (the analytic bubble bound) is
+    held to it absolutely — a simulated bubble below the bound means the
+    measurement lied, even with no prior round."""
+    (tmp_path / "PIPE_r01.json").write_text(json.dumps(
+        [{"name": "pipeline_s2_bubble_fraction", "value": 0.05,
+          "unit": "fraction", "meta": {"floor": 0.1111}}]))
+    failures, _ = benchtrack.check(str(tmp_path))
+    assert any("analytic floor" in f for f in failures), failures
+
+    (tmp_path / "PIPE_r01.json").write_text(json.dumps(
+        [{"name": "pipeline_s2_bubble_fraction", "value": 0.1111,
+          "unit": "fraction", "meta": {"floor": 0.1111}}]))
+    failures, _ = benchtrack.check(str(tmp_path))
+    assert not failures, failures
+
+
+def test_pipe_host_envelope_rebaselines(tmp_path):
+    """Rounds measured on different host envelopes (config row's
+    ``meta.host_cpus``) never price round-over-round moves against each
+    other; same-envelope rounds still gate."""
+    def pipe(n, tps, cpus=None):
+        rows = [{"name": "pipeline_s2_tokens_per_s", "value": tps,
+                 "unit": "tokens/s"}]
+        if cpus is not None:
+            rows.append({"name": "config", "value": 0, "unit": "meta",
+                         "meta": {"host_cpus": cpus}})
+        (tmp_path / f"PIPE_r{n:02d}.json").write_text(json.dumps(rows))
+
+    pipe(1, 9000.0)            # legacy round, unknown envelope
+    pipe(2, 900.0, cpus=1)     # 10x "drop" on a 1-core box: re-baseline
+    failures, passes = benchtrack.check(str(tmp_path))
+    assert not failures, failures
+    assert any("host envelope changed" in p for p in passes), passes
+
+    pipe(3, 500.0, cpus=1)     # same envelope: the relative gate fires
+    failures, _ = benchtrack.check(str(tmp_path))
+    assert any("tokens_per_s" in f for f in failures), failures
+
+
 def test_trajectory_normalizes_heterogeneous_schemas(tmp_path):
     """BENCH nests under `parsed`, PIPE is a list of name/value entries,
     STRESS is flat — all land in the one trajectory schema, rounds

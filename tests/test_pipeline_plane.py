@@ -26,6 +26,7 @@ from ray_tpu.train.pipeline import (
     PipelineConfig,
     PipelineTrainer,
     bubble_upper_bound,
+    build_interleaved_schedule,
     build_schedule,
     make_microbatches,
     max_inflight_activations,
@@ -76,17 +77,17 @@ def cluster():
 def test_1f1b_schedule_golden_2x4():
     sched = build_schedule(2, 4)
     assert [tuple(op) for op in sched[0]] == [
-        ("fwd", 0), ("send_f", 0),
-        ("fwd", 1), ("send_f", 1), ("recv_b", 0), ("bwd", 0),
-        ("fwd", 2), ("send_f", 2), ("recv_b", 1), ("bwd", 1),
-        ("fwd", 3), ("send_f", 3), ("recv_b", 2), ("bwd", 2),
-        ("recv_b", 3), ("bwd", 3),
+        ("fwd", 0, 0), ("send_f", 0, 0),
+        ("fwd", 1, 0), ("send_f", 1, 0), ("recv_b", 0, 0), ("bwd", 0, 0),
+        ("fwd", 2, 0), ("send_f", 2, 0), ("recv_b", 1, 0), ("bwd", 1, 0),
+        ("fwd", 3, 0), ("send_f", 3, 0), ("recv_b", 2, 0), ("bwd", 2, 0),
+        ("recv_b", 3, 0), ("bwd", 3, 0),
     ]
     assert [tuple(op) for op in sched[1]] == [
-        ("recv_f", 0), ("fwd", 0), ("bwd", 0), ("send_b", 0),
-        ("recv_f", 1), ("fwd", 1), ("bwd", 1), ("send_b", 1),
-        ("recv_f", 2), ("fwd", 2), ("bwd", 2), ("send_b", 2),
-        ("recv_f", 3), ("fwd", 3), ("bwd", 3), ("send_b", 3),
+        ("recv_f", 0, 0), ("fwd", 0, 0), ("bwd", 0, 0), ("send_b", 0, 0),
+        ("recv_f", 1, 0), ("fwd", 1, 0), ("bwd", 1, 0), ("send_b", 1, 0),
+        ("recv_f", 2, 0), ("fwd", 2, 0), ("bwd", 2, 0), ("send_b", 2, 0),
+        ("recv_f", 3, 0), ("fwd", 3, 0), ("bwd", 3, 0), ("send_b", 3, 0),
     ]
 
 
@@ -94,7 +95,7 @@ def test_1f1b_schedule_properties_4x8():
     S, M = 4, 8
     sched = build_schedule(S, M)
     for s, ops in enumerate(sched):
-        kinds = [k for k, _ in ops]
+        kinds = [op.kind for op in ops]
         # every microbatch runs exactly one fwd and one bwd per stage
         assert kinds.count("fwd") == M and kinds.count("bwd") == M
         # warmup depth: S-1-s warmup forwards + the first steady-state
@@ -103,7 +104,7 @@ def test_1f1b_schedule_properties_4x8():
         assert kinds[:first_bwd].count("fwd") == min(S - s, M)
         # in-flight stash never exceeds the 1F1B bound
         inflight = peak = 0
-        for k, _ in ops:
+        for k, *_ in ops:
             if k == "fwd":
                 inflight += 1
                 peak = max(peak, inflight)
@@ -126,6 +127,89 @@ def test_1f1b_bubble_matches_analytic_bound():
     # communication costs only ever add bubble
     assert simulate(4, 8, t_comm=0.5)["bubble_fraction"] >= \
         bubble_upper_bound(4, 8)
+
+
+def test_interleaved_schedule_golden_2x4_v2():
+    """Exact per-rank op streams for S=2, V=2, M=4 (virtual stages
+    q = chunk*2 + rank; warmup = 2*(S-1-rank) + (V-1)*S)."""
+    sched = build_interleaved_schedule(2, 4, 2)
+    assert [tuple(op) for op in sched[0]] == [
+        ("fwd", 0, 0), ("send_f", 0, 0),
+        ("fwd", 1, 0), ("send_f", 1, 0),
+        ("recv_f", 0, 1), ("fwd", 0, 1), ("send_f", 0, 1),
+        ("recv_f", 1, 1), ("fwd", 1, 1), ("send_f", 1, 1),
+        ("fwd", 2, 0), ("send_f", 2, 0),
+        ("recv_b", 0, 1), ("bwd", 0, 1), ("send_b", 0, 1),
+        ("fwd", 3, 0), ("send_f", 3, 0),
+        ("recv_b", 1, 1), ("bwd", 1, 1), ("send_b", 1, 1),
+        ("recv_f", 2, 1), ("fwd", 2, 1), ("send_f", 2, 1),
+        ("recv_b", 0, 0), ("bwd", 0, 0),
+        ("recv_f", 3, 1), ("fwd", 3, 1), ("send_f", 3, 1),
+        ("recv_b", 1, 0), ("bwd", 1, 0),
+        ("recv_b", 2, 1), ("bwd", 2, 1), ("send_b", 2, 1),
+        ("recv_b", 3, 1), ("bwd", 3, 1), ("send_b", 3, 1),
+        ("recv_b", 2, 0), ("bwd", 2, 0),
+        ("recv_b", 3, 0), ("bwd", 3, 0),
+    ]
+    assert [tuple(op) for op in sched[1]] == [
+        ("recv_f", 0, 0), ("fwd", 0, 0), ("send_f", 0, 0),
+        ("recv_f", 1, 0), ("fwd", 1, 0), ("send_f", 1, 0),
+        ("recv_f", 0, 1), ("fwd", 0, 1), ("bwd", 0, 1), ("send_b", 0, 1),
+        ("recv_f", 1, 1), ("fwd", 1, 1), ("bwd", 1, 1), ("send_b", 1, 1),
+        ("recv_f", 2, 0), ("fwd", 2, 0), ("send_f", 2, 0),
+        ("recv_b", 0, 0), ("bwd", 0, 0), ("send_b", 0, 0),
+        ("recv_f", 3, 0), ("fwd", 3, 0), ("send_f", 3, 0),
+        ("recv_b", 1, 0), ("bwd", 1, 0), ("send_b", 1, 0),
+        ("recv_f", 2, 1), ("fwd", 2, 1), ("bwd", 2, 1), ("send_b", 2, 1),
+        ("recv_f", 3, 1), ("fwd", 3, 1), ("bwd", 3, 1), ("send_b", 3, 1),
+        ("recv_b", 2, 0), ("bwd", 2, 0), ("send_b", 2, 0),
+        ("recv_b", 3, 0), ("bwd", 3, 0), ("send_b", 3, 0),
+    ]
+
+
+def test_interleaved_schedule_properties_and_validation():
+    # every (chunk, mb) runs exactly one fwd + one bwd on its rank
+    for S, M, V in [(2, 4, 2), (4, 8, 2), (2, 4, 4), (3, 6, 2)]:
+        sched = build_interleaved_schedule(S, M, V)
+        for r, ops in enumerate(sched):
+            fwds = [(op.chunk, op.mb) for op in ops if op.kind == "fwd"]
+            bwds = [(op.chunk, op.mb) for op in ops if op.kind == "bwd"]
+            want = {(c, m) for c in range(V) for m in range(M)}
+            assert set(fwds) == want and len(fwds) == M * V, (S, M, V, r)
+            assert set(bwds) == want and len(bwds) == M * V, (S, M, V, r)
+            # in-flight stash bounded by the interleaved warmup depth
+            inflight = peak = 0
+            for k, *_ in ops:
+                if k == "fwd":
+                    inflight += 1
+                    peak = max(peak, inflight)
+                elif k == "bwd":
+                    inflight -= 1
+            assert peak <= max_inflight_activations(r, S, V), (S, M, V, r)
+    # V=1 degenerates to the plain schedule, exactly
+    assert build_interleaved_schedule(2, 4, 1) == build_schedule(2, 4)
+    # the chunk rotation only closes on whole groups of S
+    with pytest.raises(ValueError, match="divisible"):
+        build_interleaved_schedule(2, 3, 2)
+    with pytest.raises(ValueError, match="chunk"):
+        build_interleaved_schedule(2, 4, 0)
+
+
+def test_interleaved_bubble_matches_analytic_bound():
+    """The simulator (real channel semantics: FIFO edges + finite ring
+    depth) hits (S-1)/(S-1+V*M) exactly at equal per-chunk costs — and
+    never deadlocks or desyncs, which the simulator raises on."""
+    shapes = [(2, 4, 2), (2, 8, 2), (4, 8, 2), (2, 4, 4), (3, 6, 2),
+              (4, 4, 2), (2, 8, 1), (4, 8, 1)]
+    for S, M, V in shapes:
+        for depth in (0, 2):
+            sim = simulate(S, M, t_fwd=1.0, t_bwd=2.0, num_chunks=V,
+                           channel_depth=depth)
+            bound = bubble_upper_bound(S, M, V)
+            assert abs(sim["bubble_fraction"] - bound) < 1e-9, \
+                (S, M, V, depth)
+    # interleaving strictly shrinks the bubble at fixed S, M
+    assert bubble_upper_bound(4, 8, 2) < bubble_upper_bound(4, 8, 1)
 
 
 def test_partition_keys_cover_model_disjointly():
@@ -301,6 +385,80 @@ def test_two_stage_parity_timeline_kill_restore(cluster, tmp_path):
     finally:
         trainer.shutdown()
         tracing.clear()
+
+
+def test_interleaved_two_stage_parity_v2(cluster, tmp_path):
+    """S=2, V=2 (4 virtual stages on 2 ranks, non-contiguous chunks):
+    fp32 loss AND param parity vs the fused single-mesh step, plus a
+    ckpt save/restore round trip through the chunked manifest layout."""
+    import jax
+
+    from ray_tpu.parallel.mesh import create_mesh, default_mesh_axes
+    from ray_tpu.parallel.train import TrainStepBundle, make_optimizer
+
+    cfg = _cfg(n_layers=4)
+    M = 4
+    pipe = PipelineConfig(num_stages=2, num_microbatches=M,
+                          microbatch_size=2, seq_len=16,
+                          clip_global_norm=1.0, virtual_stages=2,
+                          ckpt_every=2, step_timeout_s=60.0)
+    steps = 3
+    trainer = PipelineTrainer(cfg, pipe, seed=9, run_name="ilv_parity",
+                              ckpt_root=str(tmp_path))
+    try:
+        stats = trainer.train(steps)
+        pipe_losses = [s["loss"] for s in stats]
+
+        mesh = create_mesh(default_mesh_axes(8))
+        bundle = TrainStepBundle(cfg, mesh, optimizer=make_optimizer(),
+                                 donate=False)
+        params = trainer.init_params
+        opt_state = bundle.optimizer.init(params)
+
+        def ref_step(step):
+            nonlocal params, opt_state
+            mbs = make_microbatches(cfg, pipe, 9, step)
+            batch = {k: np.concatenate([m[k] for m in mbs])
+                     for k in mbs[0]}
+            params, opt_state, loss = bundle._fused_step(
+                params, opt_state, batch)
+            return float(loss)
+
+        ref_losses = [ref_step(s) for s in range(steps)]
+        np.testing.assert_allclose(pipe_losses, ref_losses, rtol=0,
+                                   atol=1e-5)
+        merged = trainer.merged_params()
+        assert set(merged) == set(params)
+        for k in sorted(params):
+            for a, b in zip(jax.tree.leaves(params[k]),
+                            jax.tree.leaves(merged[k])):
+                np.testing.assert_allclose(
+                    np.asarray(a, np.float64), np.asarray(b, np.float64),
+                    rtol=0, atol=1e-5)
+
+        # chunked-manifest layout: the ckpt_every=2 save committed per-rank
+        # manifests nesting per virtual stage under ``chunks``, and the
+        # chunk param keys across ranks re-merge to the full model's key
+        # set (the V=1 kill/restore e2e covers gang recovery; re-forming a
+        # second gang here would double this test's wall on the 1-core
+        # tier — restore_ckpt's chunk-mismatch guard is unit-exercised by
+        # reading the trees back directly)
+        assert trainer.last_saved_step == 2
+        from ray_tpu import ckpt as ckpt_plane
+
+        seen_keys = set()
+        for s in range(pipe.num_stages):
+            store = ckpt_plane.CheckpointStore(
+                str(tmp_path / f"stage{s}"), name=f"ilv_parity-s{s}")
+            man = store.latest()
+            assert man is not None and man.step == 2
+            tree = ckpt_plane.restore_tree(store, man.ckpt_id)
+            assert set(tree["chunks"]) == {str(v * 2 + s) for v in range(2)}
+            for sub in tree["chunks"].values():
+                seen_keys |= set(sub["params"])
+        assert seen_keys == set(params)
+    finally:
+        trainer.shutdown()
 
 
 # ---------------------------------------------------------------------------

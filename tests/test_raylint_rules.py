@@ -1716,6 +1716,67 @@ def test_obs001_quant_metrics_negative_pr14_shapes():
 
 
 # ---------------------------------------------------------------------------
+# OBS001 — PR 20 channel fast-path instruments (pipe send/recv/encode
+# metrics stay prefixed + described; stage, wire bytes, and per-hop
+# timings ride span TAGS — never the metric or span name)
+# ---------------------------------------------------------------------------
+
+
+def test_obs001_pipe_channel_metrics_positive():
+    findings = lint("""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        snd = Histogram("pipe_send_seconds", "channel send wall time")
+        rcv = Histogram("ray_tpu.pipe.recv_wait_seconds")
+
+        def send_hop(stage, mb):
+            with tracing.profile(f"pipe.send.{stage}.{mb}"):
+                pass
+    """, rules=["OBS001"])
+    assert rules_of(findings) == ["OBS001"] * 3
+    assert "ray_tpu_" in findings[0].message       # unprefixed histogram
+    assert "description" in findings[1].message    # undescribed histogram
+    assert "static string" in findings[2].message  # stage/mb in span name
+
+
+def test_obs001_pipe_channel_metrics_negative_pr20_shapes():
+    # the shapes the channel fast path actually ships: described
+    # ray_tpu.pipe.* instruments tagged by stage, static pipe.send /
+    # pipe.recv span names with the hop breakdown riding tags
+    findings = lint("""
+        from ray_tpu.util import tracing
+        from ray_tpu.util.metrics import Counter, Histogram
+
+        snd = Histogram("ray_tpu.pipe.send_seconds",
+                        "per-step channel send wall time on one rank",
+                        boundaries=[0.001, 0.01, 0.1],
+                        tag_keys=("stage",))
+        rcv = Histogram("ray_tpu.pipe.recv_wait_seconds",
+                        "per-step wait on upstream channel values",
+                        boundaries=[0.001, 0.01, 0.1],
+                        tag_keys=("stage",))
+        enc = Histogram("ray_tpu.pipe.encode_seconds",
+                        "zero-copy frame encode time (extract + skeleton)",
+                        boundaries=[0.0001, 0.001, 0.01],
+                        tag_keys=("stage",))
+        wb = Counter("ray_tpu.pipe.wire_bytes",
+                     "activation/grad bytes written to channel rings",
+                     tag_keys=("stage",))
+
+        def send_hop(stage, mb, nbytes, encode_s, ack_wait_s):
+            with tracing.profile("pipe.send", category="pipe", stage=stage,
+                                 mb=mb, wire_bytes=nbytes,
+                                 encode_s=encode_s, ack_wait_s=ack_wait_s):
+                pass
+            with tracing.profile("pipe.recv", category="pipe", stage=stage,
+                                 mb=mb, wire_bytes=nbytes):
+                pass
+    """, rules=["OBS001"])
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
 # OBS001 — PR 17 serve autoscale-plane instruments (arrival-rate/queue-depth
 # gauges, shed + prefix-cache counters stay prefixed + described; the
 # deployment name rides TAGS, never the metric or span name)

@@ -1,24 +1,37 @@
 """Pipeline-parallel microbenchmark: 1F1B bubble + throughput vs single
 mesh (bench.py-style JSON output; writes PIPE_r*.json at the repo root).
 
-Measures, per stage count S (default 2 and 4, M microbatches each):
+Measures, per stage count S (default 2 and 4, M microbatches each) and
+optionally per interleave factor V (``--interleave``):
 
 - ``tokens_per_s``: end-to-end pipeline training throughput over real
   stage actors + channels, vs the single-mesh fused ``TrainStepBundle``
   step at the same total batch (the equal-chip-count baseline on the CPU
   tier: both sides own the same 8 virtual devices).
-- ``bubble_fraction``: the 1F1B schedule's analytic bubble from the
-  event simulator (exactly (S-1)/(S-1+M) at equal per-microbatch costs —
-  the acceptance bound), plus the *measured* per-stage idle fraction
-  (wall - compute)/wall, which on the CPU tier also carries
+- ``bubble_fraction``: the (interleaved) 1F1B schedule's analytic bubble
+  from the event simulator — exactly (S-1)/(S-1+V*M) at equal per-chunk
+  costs, carried as ``meta.floor`` on the row so benchtrack can hold the
+  measurement to the analytic bound — plus the *measured* per-stage idle
+  fraction (wall - compute)/wall, which on the CPU tier also carries
   serialization + channel costs.
 - ``activation_bytes_per_microbatch``: what one microbatch hand-off
   puts on the wire between adjacent stages.
+- per-hop channel breakdown (``hop_*_ms`` rows): where one training
+  step's channel time goes on the zero-copy fast path — array extract
+  (encode), skeleton pickle, slot memcpy (copy), downstream ack wait,
+  and reader-side decode. ``hop_pickle_ms`` prices ONLY the tree
+  skeleton: a fat pickle row here means arrays fell off the zero-copy
+  path.
+
+``--activation-compression int8`` streams forward activations quantized
+(block-scaled int8 codes on the wire, exact gradients); rows gain a
+``_q8`` tag so they never alias the exact-path trajectory.
 
 Usage::
 
     python tools/bench_pipeline.py [--stages 2,4] [--microbatches 8]
-        [--steps 3] [--out PIPE_r01.json]
+        [--interleave 2] [--activation-compression int8]
+        [--steps 3] [--out PIPE_r02.json]
 """
 
 from __future__ import annotations
@@ -42,8 +55,33 @@ def _bench_cfg(n_layers: int):
                                n_kv_heads=4, remat=False)
 
 
+_HOP_KEYS = ("send_encode_s", "send_pickle_s", "send_copy_s",
+             "send_ack_wait_s", "recv_copy_s", "recv_decode_s")
+
+
+def _hop_rows(prefix: str, hops: list) -> list:
+    """Aggregate one step's per-rank hop stats into ``hop_*_ms`` rows
+    (summed across ranks: total channel time spent per step)."""
+    rows = []
+    for key in _HOP_KEYS:
+        total = sum(h.get(key, 0.0) for h in hops)
+        name = key[:-2].replace("send_", "hop_").replace("recv_", "hop_rx_")
+        rows.append({"name": f"{prefix}_{name}_ms", "value": total * 1e3,
+                     "unit": "ms"})
+    wire = sum(h.get("send_wire_bytes", 0) for h in hops)
+    rows.append({"name": f"{prefix}_hop_wire_bytes", "value": wire,
+                 "unit": "bytes"})
+    # bytes that still pass through pickle: the tree skeleton only.
+    # wire - pickled = bytes that rode the zero-copy array path
+    pickled = sum(h.get("send_skel_bytes", 0) for h in hops)
+    rows.append({"name": f"{prefix}_hop_pickled_bytes", "value": pickled,
+                 "unit": "bytes"})
+    return rows
+
+
 def main(stages=(2, 4), microbatches: int = 8, microbatch_size: int = 2,
          seq_len: int = 64, steps: int = 3, n_layers: int = 4,
+         interleave: int = 1, activation_compression: str = None,
          out: str = None) -> list:
     import numpy as np
 
@@ -82,39 +120,53 @@ def main(stages=(2, 4), microbatches: int = 8, microbatch_size: int = 2,
     rows.append({"name": "single_mesh_tokens_per_s", "value": single_tps,
                  "unit": "tokens/s"})
 
-    # -- pipeline at each stage count -------------------------------------
-    for S in stages:
+    # -- pipeline at each (stage count, interleave) -----------------------
+    variants = [(S, 1) for S in stages]
+    if interleave > 1:
+        # each of the S*V virtual stages needs at least one layer
+        variants += [(S, interleave) for S in stages
+                     if S * interleave <= n_layers]
+    for S, V in variants:
+        tag = f"pipeline_s{S}" + (f"v{V}" if V > 1 else "") \
+            + ("_q8" if activation_compression else "")
         pipe = PipelineConfig(num_stages=S, num_microbatches=microbatches,
                               microbatch_size=microbatch_size,
-                              seq_len=seq_len)
-        trainer = PipelineTrainer(cfg, pipe, run_name=f"bench_pipe_s{S}")
+                              seq_len=seq_len, virtual_stages=V,
+                              activation_compression=activation_compression)
+        trainer = PipelineTrainer(cfg, pipe,
+                                  run_name=f"bench_pipe_s{S}v{V}")
         try:
             trainer.train(1)  # compile + warm the channels
             t0 = time.perf_counter()
             stats = trainer.train(1 + steps)
             elapsed = time.perf_counter() - t0
             tps = steps * batch_tokens / elapsed
-            sim = simulate(S, microbatches)
+            bound = bubble_upper_bound(S, microbatches, V)
+            sim = simulate(S, microbatches, num_chunks=V,
+                           channel_depth=pipe.channel_depth)
             measured_idle = float(np.mean(
                 [1.0 - c / w for c, w in
                  zip(stats[-1]["compute_s"],
                      [stats[-1]["wall_s"]] * S)]))
             rows += [
-                {"name": f"pipeline_s{S}_tokens_per_s", "value": tps,
+                {"name": f"{tag}_tokens_per_s", "value": tps,
                  "unit": "tokens/s"},
-                {"name": f"pipeline_s{S}_vs_single_mesh", "value":
+                {"name": f"{tag}_vs_single_mesh", "value":
                  tps / single_tps, "unit": "x"},
-                {"name": f"pipeline_s{S}_bubble_fraction",
-                 "value": sim["bubble_fraction"], "unit": "fraction"},
-                {"name": f"pipeline_s{S}_bubble_bound",
-                 "value": bubble_upper_bound(S, microbatches),
+                # the simulator's bubble can never undercut the analytic
+                # bound; benchtrack enforces the floor on this row
+                {"name": f"{tag}_bubble_fraction",
+                 "value": sim["bubble_fraction"], "unit": "fraction",
+                 "meta": {"floor": bound}},
+                {"name": f"{tag}_bubble_bound", "value": bound,
                  "unit": "fraction"},
-                {"name": f"pipeline_s{S}_idle_fraction_measured",
+                {"name": f"{tag}_idle_fraction_measured",
                  "value": measured_idle, "unit": "fraction"},
-                {"name": f"pipeline_s{S}_activation_bytes_per_microbatch",
+                {"name": f"{tag}_activation_bytes_per_microbatch",
                  "value": stats[-1]["activation_bytes_per_mb"],
                  "unit": "bytes"},
             ]
+            rows += _hop_rows(tag, stats[-1].get("hop", []))
         finally:
             trainer.shutdown()
 
@@ -122,7 +174,14 @@ def main(stages=(2, 4), microbatches: int = 8, microbatch_size: int = 2,
                  "meta": {"n_layers": n_layers, "d_model": cfg.d_model,
                           "microbatches": microbatches,
                           "microbatch_size": microbatch_size,
-                          "seq_len": seq_len, "steps": steps}})
+                          "seq_len": seq_len, "steps": steps,
+                          "interleave": interleave,
+                          "activation_compression":
+                          activation_compression,
+                          # the host envelope: benchtrack only prices
+                          # round-over-round moves between rounds from
+                          # comparable machines
+                          "host_cpus": os.cpu_count()}})
     if out:
         with open(out, "w") as f:
             json.dump(rows, f, indent=1)
@@ -137,11 +196,17 @@ if __name__ == "__main__":
     ap.add_argument("--seq-len", type=int, default=64)
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--n-layers", type=int, default=4)
+    ap.add_argument("--interleave", type=int, default=1,
+                    help="also bench V model chunks per rank (V>1)")
+    ap.add_argument("--activation-compression", default=None,
+                    help="stream fwd activations quantized (e.g. int8)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     rows = main(stages=tuple(int(s) for s in args.stages.split(",")),
                 microbatches=args.microbatches,
                 microbatch_size=args.microbatch_size,
                 seq_len=args.seq_len, steps=args.steps,
-                n_layers=args.n_layers, out=args.out)
+                n_layers=args.n_layers, interleave=args.interleave,
+                activation_compression=args.activation_compression,
+                out=args.out)
     print(json.dumps(rows, indent=1))

@@ -179,6 +179,42 @@ def _extract_pipe(payload) -> Dict[str, float]:
     return out
 
 
+def _extract_pipe_floors(payload) -> Dict[str, float]:
+    """Per-row ``meta.floor`` annotations (e.g. the analytic bubble bound
+    under a simulated bubble_fraction): an absolute floor the value must
+    hold REGARDLESS of direction — a lower-better metric dropping below
+    its analytic floor means the measurement lied, not that it improved."""
+    if not isinstance(payload, list):
+        return {}
+    out = {}
+    for entry in payload:
+        if not isinstance(entry, dict):
+            continue
+        name = entry.get("name")
+        floor = (entry.get("meta") or {}).get("floor") \
+            if isinstance(entry.get("meta"), dict) else None
+        if (isinstance(name, str) and isinstance(floor, (int, float))
+                and not isinstance(floor, bool)):
+            out[name] = float(floor)
+    return out
+
+
+def _extract_pipe_host(payload) -> Optional[int]:
+    """The host envelope the round was measured on (the config row's
+    ``meta.host_cpus``). Rounds from different envelopes are not
+    comparable round-over-round: a 64-core round vs a 1-core round would
+    read as a catastrophic throughput regression when nothing regressed."""
+    if not isinstance(payload, list):
+        return None
+    for entry in payload:
+        if isinstance(entry, dict) and entry.get("name") == "config":
+            cpus = (entry.get("meta") or {}).get("host_cpus") \
+                if isinstance(entry.get("meta"), dict) else None
+            if isinstance(cpus, int):
+                return cpus
+    return None
+
+
 FAMILIES = {
     "BENCH": _extract_bench,
     "STRESS": _extract_flat,
@@ -210,9 +246,14 @@ def load_trajectory(root: str = REPO_ROOT) -> Dict[str, List[dict]]:
         metrics = FAMILIES[family](payload)
         if not metrics:
             continue
-        out.setdefault(family, []).append(
-            {"round": rnd, "file": os.path.basename(path),
-             "metrics": metrics})
+        rec = {"round": rnd, "file": os.path.basename(path),
+               "metrics": metrics}
+        if family == "PIPE":
+            floors = _extract_pipe_floors(payload)
+            if floors:
+                rec["floors"] = floors
+            rec["host_cpus"] = _extract_pipe_host(payload)
+        out.setdefault(family, []).append(rec)
     for rounds in out.values():
         rounds.sort(key=lambda r: r["round"])
     return out
@@ -229,6 +270,15 @@ def check(root: str = REPO_ROOT) -> Tuple[List[str], List[str]]:
     for family, rounds in sorted(trajectory.items()):
         latest = rounds[-1]
         prev = rounds[-2] if len(rounds) > 1 else None
+        if prev is not None and "host_cpus" in latest \
+                and latest["host_cpus"] != prev.get("host_cpus"):
+            # incomparable host envelopes: absolute bars/floors still
+            # apply, but round-over-round moves re-baseline here
+            passes.append(
+                f"{family} {latest['file']}: host envelope changed "
+                f"({prev.get('host_cpus')} -> {latest['host_cpus']} "
+                f"cpus), relative gate re-baselined")
+            prev = None
         for metric, value in sorted(latest["metrics"].items()):
             spec = spec_for(metric)
             if spec is None:
@@ -243,6 +293,14 @@ def check(root: str = REPO_ROOT) -> Tuple[List[str], List[str]]:
                 failures.append(
                     f"{where}: {value:g} under the absolute floor "
                     f"{spec.floor:g}")
+                continue
+            # per-row floor metadata (PIPE: the analytic bubble bound);
+            # 1e-9 slack because the simulated bubble EQUALS the bound
+            meta_floor = latest.get("floors", {}).get(metric)
+            if meta_floor is not None and value < meta_floor - 1e-9:
+                failures.append(
+                    f"{where}: {value:g} under the analytic floor "
+                    f"{meta_floor:g} (meta.floor)")
                 continue
             base = (prev or {}).get("metrics", {}).get(metric) \
                 if prev else None
